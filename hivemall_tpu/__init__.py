@@ -1,0 +1,21 @@
+"""hivemall-tpu: a TPU-native (JAX/XLA/Pallas/pjit) machine-learning framework
+with the capabilities of Apache Hivemall.
+
+Reference behavior blueprint: /root/reference (L3Sota/hivemall v0.4.2-rc.1).
+See SURVEY.md for the layer map this package mirrors:
+
+- ``hivemall_tpu.utils``    -> utility substrate (hashing, parsing, options)  [ref L0]
+- ``hivemall_tpu.core``     -> model state pytrees + batched update engine    [ref L1]
+- ``hivemall_tpu.parallel`` -> collective model mixing (MIX replacement)      [ref L2/L2']
+- ``hivemall_tpu.models``   -> learners (linear, multiclass, FM/FFM, MF, trees) [ref L3]
+- ``hivemall_tpu.ftvec``, ``knn``, ``evaluation``, ``ensemble``, ``tools``,
+  ``dataset``               -> feature engineering & query-utility functions  [ref L4]
+- ``hivemall_tpu.sql``      -> the SQL-name function registry (define-all.hive parity) [ref L5]
+"""
+
+VERSION = "0.4.2-rc.1+tpu0"
+
+
+def version() -> str:
+    """Mirrors hivemall_version() (ref: core/.../HivemallVersionUDF.java)."""
+    return VERSION

@@ -1,0 +1,122 @@
+"""Feature blocks: the on-device batch format for all hashed-feature learners.
+
+The reference processes one Hive row at a time (`process(Object[])`,
+BinaryOnlineClassifierUDTF.java:111). TPU-first, rows are staged into HBM as
+fixed-shape padded blocks:
+
+    indices [B, K] int32  — hashed feature ids, padded with `dims` (out of range)
+    values  [B, K] f32    — feature values, padded with 0
+    labels  [B]    f32    — ±1 for classifiers, y for regressors
+
+Padding with an OUT-OF-RANGE index (== dims) instead of a mask array lets every
+gather use mode='fill' (reads 0 / neutral) and every scatter use mode='drop'
+(padding lanes vanish), so kernels never multiply by a mask and XLA sees static
+shapes. K is bucketed to powers of two to bound recompilation
+(SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+
+class FeatureBlock(NamedTuple):
+    indices: np.ndarray  # [B, K] int32 (device or host)
+    values: np.ndarray  # [B, K] float32
+    labels: np.ndarray  # [B] float32
+    nnz: np.ndarray  # [B] int32 — true row lengths (for norms the pad lanes
+    # already contribute 0, so this is informational/debug)
+
+    @property
+    def batch_size(self) -> int:
+        return self.indices.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.indices.shape[1]
+
+
+def pad_to_bucket(k: int, min_width: int = 8) -> int:
+    """Round row width up to a power of two >= min_width (bounds the number of
+    distinct compiled shapes)."""
+    w = min_width
+    while w < k:
+        w <<= 1
+    return w
+
+
+def pack_rows(
+    idx_rows: Sequence[np.ndarray],
+    val_rows: Sequence[np.ndarray],
+    labels: Sequence[float],
+    dims: int,
+    width: Optional[int] = None,
+    batch_size: Optional[int] = None,
+) -> FeatureBlock:
+    """Pack variable-length hashed rows into one padded FeatureBlock.
+
+    Rows longer than `width` are truncated (callers should pick width >= max
+    nnz; `pad_to_bucket(max_nnz)` is the default). If `batch_size` is given,
+    the block is padded with empty rows up to it (their labels are 0 and all
+    lanes are dropped, so they are true no-ops in every learner).
+    """
+    n = len(idx_rows)
+    max_nnz = max((len(r) for r in idx_rows), default=1)
+    if width is None:
+        width = pad_to_bucket(max_nnz)
+    b = batch_size if batch_size is not None else n
+    indices = np.full((b, width), dims, dtype=np.int32)
+    values = np.zeros((b, width), dtype=np.float32)
+    labs = np.zeros((b,), dtype=np.float32)
+    nnz = np.zeros((b,), dtype=np.int32)
+    for i in range(n):
+        k = min(len(idx_rows[i]), width)
+        indices[i, :k] = idx_rows[i][:k]
+        values[i, :k] = val_rows[i][:k]
+        labs[i] = labels[i]
+        nnz[i] = k
+    return FeatureBlock(indices, values, labs, nnz)
+
+
+def iter_blocks(
+    idx_rows: Sequence[np.ndarray],
+    val_rows: Sequence[np.ndarray],
+    labels: Sequence[float],
+    dims: int,
+    batch_size: int,
+    width: Optional[int] = None,
+):
+    """Yield fixed-shape FeatureBlocks over a dataset (last block padded)."""
+    n = len(idx_rows)
+    if width is None:
+        max_nnz = max((len(r) for r in idx_rows), default=1)
+        width = pad_to_bucket(max_nnz)
+    for start in range(0, n, batch_size):
+        end = min(start + batch_size, n)
+        yield pack_rows(
+            idx_rows[start:end],
+            val_rows[start:end],
+            labels[start:end],
+            dims,
+            width=width,
+            batch_size=batch_size,
+        )
+
+
+def shuffle_rows(
+    idx_rows: List[np.ndarray],
+    val_rows: List[np.ndarray],
+    labels: np.ndarray,
+    seed: int,
+):
+    """Host-side shuffle between epochs (the reference's rand_amplify /
+    epoch-replay analog, ref: ftvec/amplify/RandomAmplifierUDTF.java:43-66)."""
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(len(idx_rows))
+    return (
+        [idx_rows[i] for i in perm],
+        [val_rows[i] for i in perm],
+        np.asarray(labels)[perm],
+    )

@@ -1,0 +1,243 @@
+"""The batched update engine shared by every hashed-feature linear learner.
+
+The reference's hot loop is `process(row) -> train -> model.set(feature, ...)`
+(ref: BinaryOnlineClassifierUDTF.java:111-247). On TPU that becomes, per
+FeatureBlock [B, K]:
+
+- **scan mode** — `lax.scan` over the B rows; each row gathers its K touched
+  slots, computes the rule's closed-form update, scatter-adds the deltas.
+  Bit-faithful to the reference's sequential semantics (used for parity tests
+  and small models).
+- **minibatch mode** — one vectorized gather [B, K], the rule vmapped over
+  rows against the *stale* batch-start weights, deltas scatter-added (averaged
+  per feature when `mini_batch_average`). This is exactly the reference's own
+  documented mini-batch semantic (ref: RegressionBaseUDTF.java:236-295:
+  accumulate per-feature deltas over the batch, apply the average once), and
+  is the TPU hot path: one big gather + vectorized math + one big scatter.
+
+Padding protocol (see core/batch.py): pad index == dims is out-of-range, so
+gathers use mode='fill' and scatters mode='drop' — no mask tensors anywhere.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from .state import LinearState
+
+
+@struct.dataclass
+class RowContext:
+    """Everything a rule sees for one row (gathered, padded lanes are 0)."""
+
+    w: jnp.ndarray  # [K] current weights
+    cov: Optional[jnp.ndarray]  # [K] current covariance (None if unused)
+    slots: Dict[str, jnp.ndarray]  # [K] optimizer aux
+    val: jnp.ndarray  # [K] feature values (0 on padding)
+    y: jnp.ndarray  # [] label (+-1 or target)
+    score: jnp.ndarray  # [] sum(w * val)
+    sq_norm: jnp.ndarray  # [] sum(val^2)
+    variance: jnp.ndarray  # [] sum(cov * val^2) (0 if no covariance)
+    t: jnp.ndarray  # [] float 1-based example counter
+    globals: Dict[str, jnp.ndarray] = struct.field(default_factory=dict)  # scalar running stats
+
+
+@struct.dataclass
+class RuleOutput:
+    dw: jnp.ndarray  # [K] additive weight delta
+    loss: jnp.ndarray  # [] per-row loss contribution
+    updated: jnp.ndarray  # [] bool/float — did the rule fire (for touched/deltas)
+    dcov: Optional[jnp.ndarray] = None  # [K] additive covariance delta
+    dslots: Dict[str, jnp.ndarray] = struct.field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class Rule:
+    """A learner's closed-form per-row update.
+
+    `update(ctx, hyper) -> RuleOutput`. If `derive_w` is set, weights are a
+    pure function of the slots (dual-averaging learners like AdaGradRDA):
+    after slot deltas are applied the engine recomputes w at touched lanes
+    (ref: AdaGradRDAUDTF.java:112-142 where w is rebuilt from u, G, t).
+    """
+
+    name: str
+    update: Callable[[RowContext, dict], RuleOutput]
+    use_covariance: bool = False
+    slot_names: Tuple[str, ...] = ()
+    derive_w: Optional[Callable[[Dict[str, jnp.ndarray], jnp.ndarray, dict], jnp.ndarray]] = None
+    # Scalar running stats threaded through training (e.g. Welford target
+    # variance, ref: regression/PassiveAggressiveRegressionUDTF.java preTrain).
+    # `pre_row(globals, y) -> globals` runs before each row in scan mode;
+    # `pre_batch(globals, labels) -> globals` merges a whole block in
+    # minibatch mode (rules then see the post-merge values).
+    global_names: Tuple[str, ...] = ()
+    pre_row: Optional[Callable] = None
+    pre_batch: Optional[Callable] = None
+    # loss used for convergence accounting only
+    is_regression: bool = False
+
+
+def _gather(table: jnp.ndarray, idx: jnp.ndarray, fill: float = 0.0) -> jnp.ndarray:
+    return table.at[idx].get(mode="fill", fill_value=fill)
+
+
+def _row_ctx(state_tables, idx, val, y, t, use_cov, globals_=None):
+    weights, covars, slots = state_tables
+    w = _gather(weights, idx)
+    cov = _gather(covars, idx, fill=1.0) if use_cov else None
+    sl = {k: _gather(v, idx) for k, v in slots.items()}
+    score = jnp.sum(w * val)
+    sq_norm = jnp.sum(val * val)
+    variance = jnp.sum(cov * val * val) if use_cov else jnp.zeros(())
+    return RowContext(w, cov, sl, val, y, score, sq_norm, variance, t, globals_ or {})
+
+
+def make_train_step(
+    rule: Rule,
+    hyper: dict,
+    mode: str = "minibatch",
+    mini_batch_average: bool = True,
+    donate: bool = True,
+):
+    """Build the jitted `step(state, indices, values, labels) -> (state, loss_sum)`.
+
+    `mode='scan'` replays rows sequentially (reference-exact); `mode='minibatch'`
+    applies the whole block against batch-start weights (reference's
+    -mini_batch semantics).
+    """
+    if mode not in ("scan", "minibatch"):
+        raise ValueError(f"unknown mode {mode!r}")
+    use_cov = rule.use_covariance
+
+    def scan_step(state: LinearState, indices, values, labels):
+        def body(carry, row):
+            weights, covars, slots, touched, t, gl = carry
+            idx, val, y = row
+            tf = (t + 1).astype(jnp.float32)
+            if rule.pre_row is not None:
+                gl = rule.pre_row(gl, y)
+            ctx = _row_ctx((weights, covars, slots), idx, val, y, tf, use_cov, gl)
+            out = rule.update(ctx, hyper)
+            weights = weights.at[idx].add(out.dw, mode="drop")
+            if use_cov and out.dcov is not None:
+                covars = covars.at[idx].add(out.dcov, mode="drop")
+            new_slots = dict(slots)
+            for k, d in out.dslots.items():
+                new_slots[k] = slots[k].at[idx].add(d, mode="drop")
+            if rule.derive_w is not None:
+                # lane-wise slot values after this row's delta
+                sl_new = {k: ctx.slots[k] + out.dslots.get(k, 0.0) for k in slots}
+                w_new = rule.derive_w(sl_new, tf, hyper)
+                w_new = jnp.where(out.updated, w_new, ctx.w)
+                weights = weights.at[idx].set(w_new, mode="drop")
+            upd = out.updated.astype(jnp.int8)
+            touched = touched.at[idx].max(jnp.broadcast_to(upd, idx.shape), mode="drop")
+            return (weights, covars, new_slots, touched, t + 1, gl), out.loss
+
+        carry0 = (state.weights, state.covars, state.slots, state.touched, state.step,
+                  state.globals)
+        (weights, covars, slots, touched, step, gl), losses = jax.lax.scan(
+            body, carry0, (indices, values, labels)
+        )
+        new_state = state.replace(
+            weights=weights, covars=covars, slots=slots, touched=touched, step=step,
+            globals=gl,
+        )
+        return new_state, jnp.sum(losses)
+
+    def minibatch_step(state: LinearState, indices, values, labels):
+        b = indices.shape[0]
+        t0 = state.step
+        ts = (t0 + 1 + jnp.arange(b)).astype(jnp.float32)
+        gl = state.globals
+        if rule.pre_batch is not None:
+            gl = rule.pre_batch(gl, labels)
+
+        def per_row(idx, val, y, tf):
+            ctx = _row_ctx((state.weights, state.covars, state.slots), idx, val, y, tf,
+                           use_cov, gl)
+            return rule.update(ctx, hyper), ctx
+
+        outs, ctxs = jax.vmap(per_row)(indices, values, labels, ts)
+        upd = outs.updated.astype(jnp.float32)  # [B]
+        lane_upd = upd[:, None] * jnp.ones_like(values)  # [B, K]
+
+        weights, covars, slots = state.weights, state.covars, state.slots
+        if mini_batch_average:
+            # Per-feature averaged application, exactly the reference's
+            # FloatAccumulator semantics (RegressionBaseUDTF.java:236-295).
+            counts = jnp.zeros_like(weights).at[indices].add(lane_upd, mode="drop")
+            denom = jnp.maximum(counts, 1.0)
+            dw_sum = jnp.zeros_like(weights).at[indices].add(outs.dw, mode="drop")
+            weights = weights + dw_sum / denom
+            if use_cov and outs.dcov is not None:
+                dc_sum = jnp.zeros_like(covars).at[indices].add(outs.dcov, mode="drop")
+                covars = covars + dc_sum / denom
+        else:
+            weights = weights.at[indices].add(outs.dw, mode="drop")
+            if use_cov and outs.dcov is not None:
+                covars = covars.at[indices].add(outs.dcov, mode="drop")
+        new_slots = dict(slots)
+        for k in rule.slot_names:
+            if k in outs.dslots:
+                new_slots[k] = slots[k].at[indices].add(outs.dslots[k], mode="drop")
+        if rule.derive_w is not None:
+            # Dual-averaging weights are a pure function of the *updated*
+            # accumulators — gather-after-scatter makes duplicate features
+            # across the batch deterministic.
+            tf_end = (t0 + b).astype(jnp.float32)
+            sl_g = {k: _gather(new_slots[k], indices) for k in new_slots}
+            w_new = rule.derive_w(sl_g, tf_end, hyper)  # [B, K]
+            keep = _gather(weights, indices)
+            w_new = jnp.where(lane_upd > 0, w_new, keep)
+            weights = weights.at[indices].set(w_new, mode="drop")
+        touched = state.touched.at[indices].max(
+            lane_upd.astype(jnp.int8), mode="drop"
+        )
+        new_state = state.replace(
+            weights=weights,
+            covars=covars,
+            slots=new_slots,
+            touched=touched,
+            step=t0 + b,
+            globals=gl,
+        )
+        return new_state, jnp.sum(outs.loss)
+
+    fn = scan_step if mode == "scan" else minibatch_step
+    donate_args = (0,) if donate else ()
+    return jax.jit(fn, donate_argnums=donate_args)
+
+
+_PREDICT_CACHE: Dict[bool, Callable] = {}
+
+
+def make_predict(use_covariance: bool = False):
+    if use_covariance in _PREDICT_CACHE:
+        return _PREDICT_CACHE[use_covariance]
+    _PREDICT_CACHE[use_covariance] = _build_predict(use_covariance)
+    return _PREDICT_CACHE[use_covariance]
+
+
+def _build_predict(use_covariance: bool = False):
+    """Jitted batched predict: score [B] (and variance [B] for covariance
+    learners) — the reference's calcScoreAndNorm/calcScoreAndVariance
+    (ref: BinaryOnlineClassifierUDTF.java:169-229)."""
+
+    @jax.jit
+    def predict(state: LinearState, indices, values):
+        w = _gather(state.weights, indices)
+        score = jnp.sum(w * values, axis=-1)
+        if use_covariance and state.covars is not None:
+            cov = _gather(state.covars, indices, fill=1.0)
+            variance = jnp.sum(cov * values * values, axis=-1)
+            return score, variance
+        return score
+
+    return predict

@@ -1,0 +1,159 @@
+"""Shared trainer driver for the linear-learner family.
+
+Mirrors LearnerBaseUDTF + BinaryOnlineClassifierUDTF / RegressionBaseUDTF
+(ref: core/.../hivemall/LearnerBaseUDTF.java:61-343,
+BinaryOnlineClassifierUDTF.java:51-298, regression/RegressionBaseUDTF.java:58-295):
+option parsing, model creation, the training loop, and model emission — with
+rows staged into fixed-shape FeatureBlocks and the update rules executed as
+jitted TPU kernels (core/engine.py).
+
+Execution modes:
+- default (`-mini_batch 1`): scan mode — per-row sequential semantics,
+  reference-exact.
+- `-mini_batch B` > 1: minibatch mode — the reference's accumulate-then-
+  apply-average semantics, the TPU hot path.
+- `-iters N` + `-cv_rate`: multi-epoch with convergence checking; the epoch
+  replay that FM/MF do via NioStatefullSegment disk spills is simply re-running
+  the staged blocks (host RAM / HBM resident).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..constants import DEFAULT_NUM_FEATURES
+from ..core.batch import iter_blocks, pad_to_bucket, shuffle_rows
+from ..core.engine import Rule, make_predict, make_train_step
+from ..core.state import LinearState, init_linear_state, model_rows
+from ..ops.convergence import ConversionState
+from ..utils.feature import parse_features_batch
+from ..utils.options import CommandLine, Options
+
+
+def base_options() -> Options:
+    """Options shared by all linear learners (ref: LearnerBaseUDTF.java:85-103)."""
+    o = Options()
+    o.add("dense", "densemodel", False, "Use dense model or not (always dense on TPU)")
+    o.add("dims", "feature_dimensions", True,
+          "The dimension of model [default: 2^24 hashed space]", default=None, type=int)
+    o.add("disable_halffloat", None, False, "(accepted for parity; TPU uses fp32/bf16)")
+    o.add("mini_batch", "mini_batch_size", True,
+          "Mini batch size [default: 1 = exact per-row scan]", default=1, type=int)
+    o.add("iters", "iterations", True, "Number of epochs [default: 1]", default=1, type=int)
+    o.add("disable_cv", "disable_cvtest", False, "Disable convergence check")
+    o.add("cv_rate", "convergence_rate", True, "Convergence rate [default: 0.005]",
+          default=0.005, type=float)
+    # TPU-native extensions
+    o.add("block_size", None, True, "Rows per staged device block [default: 4096]",
+          default=4096, type=int)
+    o.add("shuffle", None, False, "Shuffle rows between epochs")
+    o.add("seed", None, True, "Shuffle seed", default=31, type=int)
+    return o
+
+
+ArrayRows = Tuple[List[np.ndarray], List[np.ndarray]]
+FeatureRows = Union[Sequence[Sequence[str]], ArrayRows]
+
+
+def _stage_rows(features: FeatureRows, dims: int) -> ArrayRows:
+    if isinstance(features, tuple) and len(features) == 2:
+        idx_rows = [np.asarray(r, dtype=np.int64) % dims for r in features[0]]
+        val_rows = [np.asarray(v, dtype=np.float32) for v in features[1]]
+        return idx_rows, val_rows
+    return parse_features_batch(features, dims)
+
+
+@dataclass
+class TrainedLinearModel:
+    """A fitted model: holds device state + the jitted predictor."""
+
+    state: LinearState
+    rule: Rule
+    dims: int
+    block_width: int
+
+    def predict(self, features: FeatureRows, return_variance: bool = False):
+        """Batched scoring — the SQL join+sum inference path collapsed into one
+        gather-dot kernel (ref: SURVEY.md §3.5; tools/math/SigmoidGenericUDF.java)."""
+        idx_rows, val_rows = _stage_rows(features, self.dims)
+        n = len(idx_rows)
+        width = pad_to_bucket(max((len(r) for r in idx_rows), default=1))
+        want_var = return_variance and self.rule.use_covariance
+        predict = make_predict(use_covariance=want_var)
+        scores, variances = [], []
+        for block in iter_blocks(idx_rows, val_rows, np.zeros(n), self.dims, 4096, width):
+            out = predict(self.state, block.indices, block.values)
+            if want_var:
+                scores.append(np.asarray(out[0]))
+                variances.append(np.asarray(out[1]))
+            else:
+                scores.append(np.asarray(out))
+        if want_var:
+            return np.concatenate(scores)[:n], np.concatenate(variances)[:n]
+        return np.concatenate(scores)[:n]
+
+    def model_rows(self, filter_zero: bool = False):
+        return model_rows(self.state, filter_zero)
+
+
+def fit_linear(
+    rule: Rule,
+    hyper: dict,
+    cl: CommandLine,
+    features: FeatureRows,
+    labels: Sequence[float],
+    label_map: Callable[[np.ndarray], np.ndarray] = None,
+    initial_weights: Optional[np.ndarray] = None,
+    initial_covars: Optional[np.ndarray] = None,
+    default_dims: int = DEFAULT_NUM_FEATURES,
+) -> TrainedLinearModel:
+    """The generic fit loop used by every classifier/regressor `train_*`."""
+    dims = cl.get_int("dims") or default_dims
+    mini_batch = cl.get_int("mini_batch", 1)
+    iters = cl.get_int("iters", 1)
+    block_size = cl.get_int("block_size", 4096)
+    labels = np.asarray(labels, dtype=np.float32)
+    if label_map is not None:
+        labels = label_map(labels)
+
+    idx_rows, val_rows = _stage_rows(features, dims)
+    n = len(idx_rows)
+    if n == 0:
+        raise ValueError("no training rows")
+    width = pad_to_bucket(max((len(r) for r in idx_rows), default=1))
+
+    mode = "minibatch" if mini_batch > 1 else "scan"
+    if mode == "minibatch":
+        block_size = mini_batch
+    step = make_train_step(rule, hyper, mode=mode)
+    state = init_linear_state(
+        dims,
+        use_covariance=rule.use_covariance,
+        slot_names=rule.slot_names,
+        global_names=rule.global_names,
+        initial_weights=initial_weights,
+        initial_covars=initial_covars,
+    )
+
+    conv = ConversionState(not cl.has("disable_cv"), cl.get_float("cv_rate", 0.005))
+    for it in range(max(1, iters)):
+        if cl.has("shuffle") and it > 0:
+            idx_rows, val_rows, labels = shuffle_rows(
+                idx_rows, val_rows, labels, cl.get_int("seed", 31) + it
+            )
+        epoch_loss = 0.0
+        for block in iter_blocks(idx_rows, val_rows, labels, dims, block_size, width):
+            state, loss = step(state, block.indices, block.values, block.labels)
+            epoch_loss += float(loss)
+        conv.incr_loss(epoch_loss)
+        if iters > 1 and conv.is_converged(n):
+            break
+    return TrainedLinearModel(state=state, rule=rule, dims=dims, block_width=width)
+
+
+def binary_label_map(labels: np.ndarray) -> np.ndarray:
+    """int labels -> {-1, +1} (ref: BinaryOnlineClassifierUDTF train: y = label > 0 ? 1 : -1)."""
+    return np.where(labels > 0, 1.0, -1.0).astype(np.float32)

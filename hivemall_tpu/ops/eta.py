@@ -1,0 +1,75 @@
+"""Learning-rate schedules.
+
+Mirrors hivemall.common.EtaEstimator (ref: core/.../common/EtaEstimator.java:31-160):
+fixed, simple (eta0 / (1 + t/total)), inverse-scaling (eta0 / t^power_t), and
+the bold-driver "adjusting" estimator from Gemulla et al. KDD'11.
+
+Schedules are pure functions of the global step `t` so they trace cleanly
+under jit; `t` is carried in the model state. The factory `get_eta` mirrors
+the reference's CLI resolution order (EtaEstimator.get, :128-160).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class EtaEstimator:
+    kind: str  # fixed | simple | invscaling | adjusting
+    eta0: float = 0.1
+    total_steps: float = 10000.0
+    power_t: float = 0.1
+
+    def eta(self, t):
+        """eta(t) with t the 1-based example counter. Traceable under jit."""
+        t = jnp.asarray(t, dtype=jnp.float32)
+        if self.kind == "fixed":
+            return jnp.full_like(t, self.eta0)
+        if self.kind == "simple":
+            final_eta = self.eta0 / 2.0
+            return jnp.where(
+                t > self.total_steps,
+                final_eta,
+                self.eta0 / (1.0 + t / self.total_steps),
+            )
+        if self.kind == "invscaling":
+            return self.eta0 / jnp.power(jnp.maximum(t, 1.0), self.power_t)
+        if self.kind == "adjusting":
+            # Bold driver adjusts from the loss trajectory at iteration
+            # boundaries (host-side, see models/base.py); eta(t) is flat within
+            # an iteration (ref: EtaEstimator.java:99-122).
+            return jnp.full_like(t, self.eta0)
+        raise ValueError(f"unknown eta kind {self.kind}")
+
+
+def fixed(eta: float) -> EtaEstimator:
+    return EtaEstimator("fixed", eta0=eta)
+
+
+def simple(eta0: float, total_steps: int) -> EtaEstimator:
+    return EtaEstimator("simple", eta0=eta0, total_steps=float(total_steps))
+
+
+def invscaling(eta0: float, power_t: float) -> EtaEstimator:
+    return EtaEstimator("invscaling", eta0=eta0, power_t=power_t)
+
+
+def get_eta(cl=None, default_eta0: float = 0.1) -> EtaEstimator:
+    """Resolve schedule from parsed options, mirroring EtaEstimator.get
+    (ref: EtaEstimator.java:128-160). `cl` is a utils.options.CommandLine."""
+    if cl is None:
+        return invscaling(default_eta0, 0.1)
+    if cl.has("boldDriver"):
+        eta = cl.get_float("eta", 0.3)
+        return EtaEstimator("adjusting", eta0=eta)
+    if cl.has("eta"):
+        return fixed(cl.get_float("eta"))
+    eta0 = cl.get_float("eta0", default_eta0)
+    if cl.has("t"):
+        return simple(eta0, cl.get_int("t"))
+    power_t = cl.get_float("power_t", 0.1)
+    return invscaling(eta0, power_t)

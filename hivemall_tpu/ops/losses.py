@@ -1,0 +1,114 @@
+"""Loss functions as pure jnp element-wise ops.
+
+Mirrors hivemall.common.LossFunctions (ref: core/.../common/LossFunctions.java:26-379):
+SquaredLoss, LogLoss, HingeLoss, SquaredHingeLoss, QuantileLoss,
+EpsilonInsensitiveLoss — each with `loss(p, y)` and `dloss(p, y)`.
+
+All functions are vectorized over arrays (the reference computes them per-row;
+on TPU they fuse into the batched update kernels). Binary losses take y in
+{-1, +1}.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax.numpy as jnp
+
+
+class LossFunction(NamedTuple):
+    name: str
+    loss: Callable
+    dloss: Callable
+    is_binary: bool
+
+
+def _squared_loss(p, y):
+    z = p - y
+    return 0.5 * z * z
+
+
+def _squared_dloss(p, y):
+    return p - y
+
+
+def _log_loss(p, y):
+    # log(1 + exp(-y*p)), numerically stable (ref: LossFunctions.java LogLoss.loss,
+    # which branches at |z| > 18; softplus(-z) is the branch-free equivalent).
+    z = y * p
+    return jnp.logaddexp(0.0, -z)
+
+
+def _log_dloss(p, y):
+    z = y * p
+    return -y / (jnp.exp(z) + 1.0)
+
+
+def _hinge_loss(p, y, threshold=1.0):
+    return jnp.maximum(0.0, threshold - y * p)
+
+
+def _hinge_dloss(p, y, threshold=1.0):
+    return jnp.where(threshold - y * p > 0.0, -y, 0.0)
+
+
+def _squared_hinge_loss(p, y):
+    d = jnp.maximum(0.0, 1.0 - y * p)
+    return d * d
+
+
+def _squared_hinge_dloss(p, y):
+    d = 1.0 - y * p
+    return jnp.where(d > 0.0, -2.0 * d * y, 0.0)
+
+
+def _quantile_loss(p, y, tau=0.5):
+    e = y - p
+    return jnp.where(e > 0.0, tau * e, -(1.0 - tau) * e)
+
+
+def _quantile_dloss(p, y, tau=0.5):
+    e = y - p
+    return jnp.where(e == 0.0, 0.0, jnp.where(e > 0.0, -tau, 1.0 - tau))
+
+
+def _eps_insensitive_loss(p, y, epsilon=0.1):
+    return jnp.maximum(0.0, jnp.abs(y - p) - epsilon)
+
+
+def _eps_insensitive_dloss(p, y, epsilon=0.1):
+    return jnp.where(y - p > epsilon, -1.0, jnp.where(p - y > epsilon, 1.0, 0.0))
+
+
+SquaredLoss = LossFunction("SquaredLoss", _squared_loss, _squared_dloss, False)
+LogLoss = LossFunction("LogLoss", _log_loss, _log_dloss, True)
+HingeLoss = LossFunction("HingeLoss", _hinge_loss, _hinge_dloss, True)
+SquaredHingeLoss = LossFunction("SquaredHingeLoss", _squared_hinge_loss, _squared_hinge_dloss, True)
+QuantileLoss = LossFunction("QuantileLoss", _quantile_loss, _quantile_dloss, False)
+EpsilonInsensitiveLoss = LossFunction(
+    "EpsilonInsensitiveLoss", _eps_insensitive_loss, _eps_insensitive_dloss, False
+)
+
+_REGISTRY = {
+    f.name.lower(): f
+    for f in (SquaredLoss, LogLoss, HingeLoss, SquaredHingeLoss, QuantileLoss,
+              EpsilonInsensitiveLoss)
+}
+
+
+def get_loss_function(name: str) -> LossFunction:
+    """By-name lookup (ref: LossFunctions.getLossFunction, LossFunctions.java:33-46)."""
+    f = _REGISTRY.get(name.lower())
+    if f is None:
+        raise ValueError(f"Unsupported loss type: {name}")
+    return f
+
+
+def logistic_loss(target, predicted):
+    """logisticLoss(target, predicted) for probability targets
+    (ref: LossFunctions.java:381-392)."""
+    return jnp.where(
+        predicted > -100.0,
+        target - 1.0 / (1.0 + jnp.exp(-predicted)),
+        target,
+    )

@@ -1,0 +1,15 @@
+"""Test harness configuration.
+
+Tests run on a simulated 8-device CPU mesh — the TPU-world analog of the
+reference's loopback in-process MIX servers (ref: SURVEY.md §4 takeaway;
+mixserv/src/test/java/hivemall/mix/server/MixServerTest.java boots servers
+in-process the same way). Must run before jax is imported anywhere.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,149 @@
+"""Binary classifier tests.
+
+Mirrors the reference's UDTF unit tests — exact weights after known updates
+(ref: core/src/test/java/hivemall/classifier/PerceptronUDTFTest.java:36-80) —
+plus convergence-threshold tests on synthetic data (ref: SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models import classifier as C
+
+
+def _gen_blobs(n=1000, d=20, seed=42, noise=0.0):
+    """Linearly separable-ish synthetic data as int-feature rows."""
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(d)
+    x = rng.randn(n, d).astype(np.float32)
+    y = np.sign(x @ w_true + noise * rng.randn(n)).astype(np.float32)
+    idx_rows = [np.arange(d, dtype=np.int64) for _ in range(n)]
+    val_rows = [x[i] for i in range(n)]
+    return (idx_rows, val_rows), y
+
+
+def _accuracy(model, feats, y):
+    scores = model.predict(feats)
+    return float(np.mean(np.sign(scores) == np.sign(y)))
+
+
+class TestPerceptronExact:
+    """Exact single-update weights, as PerceptronUDTFTest does."""
+
+    def test_update_on_misclassify(self):
+        # One row {0:1.0, 1:2.0}, label +1; initial w=0 -> score 0 -> update
+        # w += y*x (ref: PerceptronUDTF.java:44-50)
+        model = C.train_perceptron(([np.array([0, 1])], [np.array([1.0, 2.0])]),
+                                   [1], "-dims 16")
+        feats, weights = model.model_rows()
+        w = dict(zip(feats.tolist(), weights.tolist()))
+        assert w[0] == pytest.approx(1.0)
+        assert w[1] == pytest.approx(2.0)
+
+    def test_no_update_when_correct(self):
+        # Second row already classified correctly -> no change
+        rows = ([np.array([0, 1]), np.array([0, 1])],
+                [np.array([1.0, 2.0]), np.array([0.5, 0.5])])
+        model = C.train_perceptron(rows, [1, 1], "-dims 16")
+        feats, weights = model.model_rows()
+        w = dict(zip(feats.tolist(), weights.tolist()))
+        assert w[0] == pytest.approx(1.0)
+        assert w[1] == pytest.approx(2.0)
+
+    def test_sequence(self):
+        # Three-step hand-computed sequence
+        rows = ([np.array([0]), np.array([0]), np.array([0])],
+                [np.array([1.0]), np.array([1.0]), np.array([1.0])])
+        model = C.train_perceptron(rows, [1, -1, -1], "-dims 4")
+        # t1: w=0, y=1, score=0 <= 0 -> w=1
+        # t2: w=1, y=-1, y*score=-1 <= 0 -> w=0
+        # t3: w=0, y=-1, y*score=0 <= 0 -> w=-1
+        feats, weights = model.model_rows()
+        assert weights[0] == pytest.approx(-1.0)
+
+
+class TestPAExact:
+    def test_pa_single_update(self):
+        # PA: eta = loss/||x||^2; x=(1,2), y=1 -> loss=1, ||x||^2=5, w = (0.2, 0.4)
+        model = C.train_pa(([np.array([0, 1])], [np.array([1.0, 2.0])]), [1], "-dims 16")
+        feats, weights = model.model_rows()
+        w = dict(zip(feats.tolist(), weights.tolist()))
+        assert w[0] == pytest.approx(0.2, rel=1e-5)
+        assert w[1] == pytest.approx(0.4, rel=1e-5)
+
+    def test_pa1_clip(self):
+        # PA1 clips eta at C=0.1 (ref: PassiveAggressiveUDTF.java:109-112)
+        model = C.train_pa1(([np.array([0])], [np.array([0.1])]), [1], "-dims 4 -c 0.1")
+        _, weights = model.model_rows()
+        assert weights[0] == pytest.approx(0.1 * 0.1, rel=1e-5)
+
+    def test_pa2_eta(self):
+        # PA2: eta = loss/(||x||^2 + 1/(2C)); C=1, x=1, y=1 -> 1/(1+0.5)
+        model = C.train_pa2(([np.array([0])], [np.array([1.0])]), [1], "-dims 4 -c 1.0")
+        _, weights = model.model_rows()
+        assert weights[0] == pytest.approx(1.0 / 1.5, rel=1e-5)
+
+
+class TestAROWExact:
+    def test_single_update(self):
+        # x=1, y=1, w=0, cov=1, r=0.1: m=0, var=1, beta=1/1.1, alpha=beta
+        # w' = alpha*cov*x = 1/1.1; cov' = 1 - beta*1 = 1 - 1/1.1
+        model = C.train_arow(([np.array([0])], [np.array([1.0])]), [1], "-dims 4 -r 0.1")
+        feats, weights, covars = model.model_rows()
+        assert weights[0] == pytest.approx(1.0 / 1.1, rel=1e-5)
+        assert covars[0] == pytest.approx(1.0 - 1.0 / 1.1, rel=1e-4)
+
+    def test_no_update_when_margin_big(self):
+        # after first update, margin m = w*x*y: craft second row correct w/ margin > 1
+        rows = ([np.array([0]), np.array([0])], [np.array([1.0]), np.array([2.0])])
+        model = C.train_arow(rows, [1, 1], "-dims 4 -r 0.1")
+        # second row: score = (1/1.1)*2 = 1.818 > 1 -> no update
+        _, weights, _ = model.model_rows()
+        assert weights[0] == pytest.approx(1.0 / 1.1, rel=1e-5)
+
+
+@pytest.mark.parametrize("train_fn,opts", [
+    (C.train_perceptron, ""),
+    (C.train_pa, ""),
+    (C.train_pa1, ""),
+    (C.train_pa2, ""),
+    (C.train_cw, ""),
+    (C.train_arow, ""),
+    (C.train_arowh, ""),
+    (C.train_scw, ""),
+    (C.train_scw2, ""),
+    (C.train_adagrad_rda, ""),
+])
+def test_convergence_scan(train_fn, opts):
+    feats, y = _gen_blobs(n=600, d=16)
+    model = train_fn(feats, y, f"-dims 256 {opts}".strip())
+    acc = _accuracy(model, feats, y)
+    assert acc >= 0.93, f"{train_fn.__name__} scan acc={acc}"
+
+
+@pytest.mark.parametrize("train_fn", [C.train_perceptron, C.train_arow, C.train_scw,
+                                      C.train_adagrad_rda])
+def test_convergence_minibatch(train_fn):
+    feats, y = _gen_blobs(n=600, d=16)
+    model = train_fn(feats, y, "-dims 256 -mini_batch 64 -iters 5 -disable_cv")
+    acc = _accuracy(model, feats, y)
+    assert acc >= 0.90, f"{train_fn.__name__} minibatch acc={acc}"
+
+
+def test_string_features_hash_consistently():
+    rows = [["cat:1.0", "size:2.0"], ["cat:1.0"]]
+    model = C.train_perceptron(rows, [1, -1], "-dims 1024")
+    feats, _ = model.model_rows()
+    assert len(feats) == 2  # two distinct hashed features touched
+
+
+def test_covariance_emitted():
+    feats, y = _gen_blobs(n=50, d=8)
+    model = C.train_arow(feats, y, "-dims 64")
+    out = model.model_rows()
+    assert len(out) == 3  # (feature, weight, covar)
+
+
+def test_touched_only_emitted():
+    model = C.train_perceptron(([np.array([3])], [np.array([1.0])]), [1], "-dims 64")
+    feats, _ = model.model_rows()
+    assert feats.tolist() == [3]

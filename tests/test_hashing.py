@@ -1,0 +1,69 @@
+"""MurmurHash3 bit-compatibility tests.
+
+Ground truth is sklearn.utils.murmurhash3_32 (the canonical SMHasher C
+implementation), checked with the reference's seed 0x9747b28c and its
+signed-floor-mod fold semantics (ref: utils/hashing/MurmurHash3.java:32-46).
+"""
+
+import numpy as np
+import pytest
+from sklearn.utils import murmurhash3_32 as sk_mmh3
+
+from hivemall_tpu.utils.hashing import (
+    DEFAULT_NUM_FEATURES,
+    mhash,
+    murmurhash3_bytes_batch,
+    murmurhash3_x86_32,
+)
+
+SAMPLES = [
+    "",
+    "a",
+    "ab",
+    "abc",
+    "abcd",
+    "abcde",
+    "hello world",
+    "feature:123",
+    "日本語テキスト",
+    "0",
+    "f1048576",
+    "the quick brown fox jumps over the lazy dog",
+    "x" * 1000,
+]
+
+
+@pytest.mark.parametrize("s", SAMPLES)
+def test_matches_canonical_c_implementation(s):
+    expected = int(sk_mmh3(s, seed=0x9747B28C, positive=False))
+    assert murmurhash3_x86_32(s) == expected
+
+
+@pytest.mark.parametrize("seed", [0, 1, 42, 0x9747B28C])
+def test_seeds(seed):
+    for s in SAMPLES[:8]:
+        assert murmurhash3_x86_32(s, seed) == int(sk_mmh3(s, seed=seed, positive=False))
+
+
+def test_mhash_fold_semantics():
+    # Java: r = h % n; if (r < 0) r += n  == Python floor-mod on signed h
+    for s in SAMPLES:
+        h = murmurhash3_x86_32(s)
+        assert mhash(s) == h % DEFAULT_NUM_FEATURES
+        assert 0 <= mhash(s) < DEFAULT_NUM_FEATURES
+        assert 0 <= mhash(s, 1000003) < 1000003
+
+
+def test_batch_matches_scalar():
+    rng = np.random.RandomState(0)
+    strs = SAMPLES + [
+        "".join(chr(rng.randint(32, 0x3000)) for _ in range(rng.randint(0, 40)))
+        for _ in range(200)
+    ]
+    batch = murmurhash3_bytes_batch(strs, DEFAULT_NUM_FEATURES)
+    scalar = np.array([mhash(s) for s in strs])
+    np.testing.assert_array_equal(batch, scalar)
+
+
+def test_batch_empty():
+    assert murmurhash3_bytes_batch([]).shape == (0,)

@@ -1,0 +1,214 @@
+"""Substrate tests: feature parsing, options, losses, eta, convergence.
+
+Mirrors the reference's pure-function unit tests (ref: SURVEY.md §4:
+utils/collections/*Test, common/*)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hivemall_tpu.ops import eta as eta_mod
+from hivemall_tpu.ops import losses
+from hivemall_tpu.ops.convergence import ConversionState, OnlineVariance
+from hivemall_tpu.utils.feature import (
+    FMFeature,
+    FeatureValue,
+    add_bias,
+    extract_feature,
+    extract_weight,
+    parse_features_batch,
+    sort_by_feature,
+)
+from hivemall_tpu.utils.options import HelpRequested, OptionError, Options
+
+
+class TestFeatureValue:
+    def test_name_only(self):
+        fv = FeatureValue.parse("age")
+        assert fv.feature == "age" and fv.value == 1.0
+
+    def test_name_value(self):
+        fv = FeatureValue.parse("weight:63.2")
+        assert fv.feature == "weight" and fv.value == pytest.approx(63.2)
+
+    def test_int_feature(self):
+        fv = FeatureValue.parse("12345:0.5")
+        assert fv.feature == 12345 and fv.value == 0.5
+
+    def test_split_at_first_colon(self):
+        # ref: model/FeatureValue.java:74-93 splits at the FIRST ':' — the value
+        # part "b:1.5" then fails to parse as float, like Java's parseFloat
+        with pytest.raises(ValueError):
+            FeatureValue.parse("a:b:1.5")
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            FeatureValue.parse("")
+        with pytest.raises(ValueError):
+            FeatureValue.parse(":1.0")
+        with pytest.raises(ValueError):
+            FeatureValue.parse("a:")
+
+    def test_helpers(self):
+        assert extract_feature("height:1.2") == "height"
+        assert extract_weight("height:1.2") == pytest.approx(1.2)
+        assert add_bias(["a:1"])[-1] == "0:1.0"
+        assert sort_by_feature(["b:2", "a:1"]) == ["a:1", "b:2"]
+
+
+class TestFMFeature:
+    def test_two_part(self):
+        f = FMFeature.parse("123:0.5")
+        assert f.index == 123 and f.value == 0.5 and f.field == -1
+
+    def test_three_part(self):
+        f = FMFeature.parse("2:123:0.5")
+        assert f.field == 2 and f.index == 123 and f.value == 0.5
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            FMFeature.parse("1:2:3:4")
+
+
+class TestParseBatch:
+    def test_mixed_rows(self):
+        idx, val = parse_features_batch([["1:0.5", "2:1.5"], ["hello:2.0", (7, 3.0)]], 100)
+        assert idx[0].tolist() == [1, 2]
+        np.testing.assert_allclose(val[0], [0.5, 1.5])
+        assert idx[1][1] == 7
+        assert 0 <= idx[1][0] < 100
+        np.testing.assert_allclose(val[1], [2.0, 3.0])
+
+
+class TestOptions:
+    def _opts(self):
+        o = Options()
+        o.add("c", "aggressiveness", True, "C", default=1.0, type=float)
+        o.add("dense", None, False, "flag")
+        return o
+
+    def test_parse(self):
+        cl = self._opts().parse("-c 0.5 -dense")
+        assert cl.get_float("c") == 0.5 and cl.has("dense")
+
+    def test_long_name(self):
+        cl = self._opts().parse("--aggressiveness 2.0")
+        assert cl.get_float("c") == 2.0
+
+    def test_defaults(self):
+        cl = self._opts().parse(None)
+        assert cl.get_float("c") == 1.0 and not cl.has("dense")
+
+    def test_help(self):
+        with pytest.raises(HelpRequested):
+            self._opts().parse("-help")
+
+    def test_unknown(self):
+        with pytest.raises(OptionError):
+            self._opts().parse("-nope")
+
+
+class TestLosses:
+    def test_logloss_matches_reference_branches(self):
+        # ref: LossFunctions.java LogLoss: exp(-z) for z>18, -z for z<-18
+        f = losses.LogLoss
+        assert float(f.loss(20.0, 1.0)) == pytest.approx(math.exp(-20.0), rel=1e-6)
+        assert float(f.loss(-20.0, 1.0)) == pytest.approx(20.0, rel=1e-5)
+        assert float(f.loss(0.0, 1.0)) == pytest.approx(math.log(2.0), rel=1e-6)
+        assert float(f.dloss(0.0, 1.0)) == pytest.approx(-0.5, rel=1e-6)
+
+    def test_hinge(self):
+        f = losses.HingeLoss
+        assert float(f.loss(0.5, 1.0)) == 0.5
+        assert float(f.loss(2.0, 1.0)) == 0.0
+        assert float(f.dloss(0.5, 1.0)) == -1.0
+        assert float(f.dloss(2.0, 1.0)) == 0.0
+
+    def test_squared(self):
+        f = losses.SquaredLoss
+        assert float(f.loss(3.0, 1.0)) == 2.0
+        assert float(f.dloss(3.0, 1.0)) == 2.0
+
+    def test_quantile(self):
+        f = losses.QuantileLoss
+        assert float(f.loss(0.0, 1.0)) == 0.5
+        assert float(f.dloss(0.0, 1.0)) == -0.5
+
+    def test_epsilon_insensitive(self):
+        f = losses.EpsilonInsensitiveLoss
+        assert float(f.loss(0.0, 0.05)) == 0.0
+        assert float(f.loss(0.0, 0.5)) == pytest.approx(0.4, rel=1e-6)
+        assert float(f.dloss(0.0, 0.5)) == -1.0
+
+    def test_registry(self):
+        assert losses.get_loss_function("logloss") is losses.LogLoss
+        with pytest.raises(ValueError):
+            losses.get_loss_function("nope")
+
+
+class TestEta:
+    def test_fixed(self):
+        assert float(eta_mod.fixed(0.2).eta(100)) == pytest.approx(0.2)
+
+    def test_invscaling(self):
+        e = eta_mod.invscaling(0.1, 0.5)
+        assert float(e.eta(4)) == pytest.approx(0.05, rel=1e-6)
+
+    def test_simple(self):
+        e = eta_mod.simple(0.1, 100)
+        assert float(e.eta(0)) == pytest.approx(0.1, rel=1e-6)
+        assert float(e.eta(100)) == pytest.approx(0.05, rel=1e-6)
+        assert float(e.eta(1000)) == pytest.approx(0.05, rel=1e-6)
+
+    def test_factory(self):
+        o = Options()
+        o.add("eta", None, True, "", type=float)
+        o.add("eta0", None, True, "", type=float)
+        o.add("t", "total_steps", True, "", type=int)
+        o.add("power_t", None, True, "", type=float)
+        o.add("boldDriver", None, False, "")
+        assert eta_mod.get_eta(o.parse("-eta 0.3")).kind == "fixed"
+        assert eta_mod.get_eta(o.parse("-eta0 0.1 -t 50")).kind == "simple"
+        assert eta_mod.get_eta(o.parse(None)).kind == "invscaling"
+        assert eta_mod.get_eta(o.parse("-boldDriver")).kind == "adjusting"
+
+
+class TestConvergence:
+    def test_two_consecutive_small_changes(self):
+        # ref: ConversionState.java:86-127 — needs TWO consecutive sub-rate epochs
+        cs = ConversionState(True, 0.01)
+        cs.incr_loss(100.0)
+        assert not cs.is_converged()
+        cs.incr_loss(99.95)  # change 0.0005 < 0.01 -> ready
+        assert not cs.is_converged()
+        cs.incr_loss(99.94)
+        assert cs.is_converged()
+
+    def test_increase_resets(self):
+        cs = ConversionState(True, 0.01)
+        cs.incr_loss(100.0)
+        cs.is_converged()
+        cs.incr_loss(99.99)
+        cs.is_converged()  # ready
+        cs.incr_loss(150.0)  # increase resets
+        assert not cs.is_converged()
+        cs.incr_loss(149.9)
+        assert not cs.is_converged()
+        cs.incr_loss(149.8)
+        assert cs.is_converged()
+
+    def test_disabled(self):
+        cs = ConversionState(False, 0.01)
+        for _ in range(5):
+            cs.incr_loss(1.0)
+            assert not cs.is_converged()
+
+    def test_online_variance(self):
+        ov = OnlineVariance()
+        xs = [1.0, 2.0, 3.0, 4.0, 10.0]
+        for x in xs:
+            ov.handle(x)
+        assert ov.mean == pytest.approx(np.mean(xs))
+        assert ov.variance == pytest.approx(np.var(xs, ddof=1))
