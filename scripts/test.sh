@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Run the test suite on a simulated 8-device CPU mesh.
+#
+# PALLAS_AXON_POOL_IPS is cleared so the axon TPU relay is not dialed at
+# interpreter boot (sitecustomize) — tests are CPU-only by design; the real
+# TPU chip is used by bench.py only.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
