@@ -1,0 +1,64 @@
+"""Multiclass classifier tests (model: reference Multiclass*UDTF tests)."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models import multiclass as MC
+
+
+def _gen_multiclass(n=900, d=16, k=3, seed=3):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d) * 2.0
+    labels = rng.randint(0, k, size=n)
+    x = (centers[labels] + 0.3 * rng.randn(n, d)).astype(np.float32)
+    idx_rows = [np.arange(d, dtype=np.int64) for _ in range(n)]
+    val_rows = [x[i] for i in range(n)]
+    names = [f"class_{i}" for i in range(k)]
+    return (idx_rows, val_rows), [names[l] for l in labels]
+
+
+def test_perceptron_exact_update():
+    # one row, label "a": scores all 0 -> max other (b) ties correct -> fires;
+    # +x to "a", -x to argmax other
+    model = MC.train_multiclass_perceptron(
+        ([np.array([0, 1])], [np.array([1.0, 2.0])]), ["a"], "-dims 16",
+        num_classes=None)
+    labels, feats, weights = model.model_rows()
+    w = {(l, f): v for l, f, v in zip(labels, feats.tolist(), weights.tolist())}
+    assert w[("a", 0)] == pytest.approx(1.0)
+    assert w[("a", 1)] == pytest.approx(2.0)
+
+
+@pytest.mark.parametrize("train_fn", [
+    MC.train_multiclass_perceptron,
+    MC.train_multiclass_pa,
+    MC.train_multiclass_pa1,
+    MC.train_multiclass_pa2,
+    MC.train_multiclass_cw,
+    MC.train_multiclass_arow,
+    MC.train_multiclass_arowh,
+    MC.train_multiclass_scw,
+    MC.train_multiclass_scw2,
+])
+def test_multiclass_convergence(train_fn):
+    feats, y = _gen_multiclass()
+    model = train_fn(feats, y, "-dims 64")
+    pred = model.predict(feats)
+    acc = float(np.mean([p == t for p, t in zip(pred, y)]))
+    assert acc >= 0.9, f"{train_fn.__name__} acc={acc}"
+
+
+def test_multiclass_minibatch():
+    feats, y = _gen_multiclass()
+    model = MC.train_multiclass_arow(feats, y, "-dims 64 -mini_batch 64 -iters 3")
+    pred = model.predict(feats)
+    acc = float(np.mean([p == t for p, t in zip(pred, y)]))
+    assert acc >= 0.9, f"minibatch acc={acc}"
+
+
+def test_model_rows_have_labels():
+    feats, y = _gen_multiclass(n=50)
+    model = MC.train_multiclass_arow(feats, y, "-dims 64")
+    out = model.model_rows()
+    assert len(out) == 4  # (label, feature, weight, covar)
+    assert set(out[0]) <= {"class_0", "class_1", "class_2"}
