@@ -98,18 +98,25 @@ def _row_ctx(state_tables, idx, val, y, t, use_cov, globals_=None):
     return RowContext(w, cov, sl, val, y, score, sq_norm, variance, t, globals_ or {})
 
 
-def make_train_step(
+DELTA_SLOT = "__delta_upd"  # per-feature update count since the last mix —
+# the TPU analog of DenseModel's deltaUpdates byte array (ref: DenseModel.java:52)
+
+
+def make_train_fn(
     rule: Rule,
     hyper: dict,
     mode: str = "minibatch",
     mini_batch_average: bool = True,
-    donate: bool = True,
+    track_deltas: bool = False,
 ):
-    """Build the jitted `step(state, indices, values, labels) -> (state, loss_sum)`.
+    """Build the raw (unjitted) `step(state, indices, values, labels) ->
+    (state, loss_sum)` — composable inside shard_map/scan by parallel/mix.py.
 
     `mode='scan'` replays rows sequentially (reference-exact); `mode='minibatch'`
     applies the whole block against batch-start weights (reference's
-    -mini_batch semantics).
+    -mini_batch semantics). With `track_deltas`, state.slots[DELTA_SLOT]
+    accumulates per-feature update counts (for delta-weighted model averaging,
+    ref: PartialAverage.java:43-67).
     """
     if mode not in ("scan", "minibatch"):
         raise ValueError(f"unknown mode {mode!r}")
@@ -138,6 +145,10 @@ def make_train_step(
                 weights = weights.at[idx].set(w_new, mode="drop")
             upd = out.updated.astype(jnp.int8)
             touched = touched.at[idx].max(jnp.broadcast_to(upd, idx.shape), mode="drop")
+            if track_deltas:
+                new_slots[DELTA_SLOT] = slots[DELTA_SLOT].at[idx].add(
+                    jnp.broadcast_to(out.updated.astype(jnp.float32), idx.shape),
+                    mode="drop")
             return (weights, covars, new_slots, touched, t + 1, gl), out.loss
 
         carry0 = (state.weights, state.covars, state.slots, state.touched, state.step,
@@ -200,6 +211,9 @@ def make_train_step(
         touched = state.touched.at[indices].max(
             lane_upd.astype(jnp.int8), mode="drop"
         )
+        if track_deltas:
+            new_slots[DELTA_SLOT] = new_slots.get(DELTA_SLOT, state.slots[DELTA_SLOT]) \
+                .at[indices].add(lane_upd, mode="drop")
         new_state = state.replace(
             weights=weights,
             covars=covars,
@@ -210,9 +224,19 @@ def make_train_step(
         )
         return new_state, jnp.sum(outs.loss)
 
-    fn = scan_step if mode == "scan" else minibatch_step
-    donate_args = (0,) if donate else ()
-    return jax.jit(fn, donate_argnums=donate_args)
+    return scan_step if mode == "scan" else minibatch_step
+
+
+def make_train_step(
+    rule: Rule,
+    hyper: dict,
+    mode: str = "minibatch",
+    mini_batch_average: bool = True,
+    donate: bool = True,
+):
+    """Jitted wrapper over make_train_fn (the single-replica path)."""
+    fn = make_train_fn(rule, hyper, mode=mode, mini_batch_average=mini_batch_average)
+    return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 
 _PREDICT_CACHE: Dict[bool, Callable] = {}

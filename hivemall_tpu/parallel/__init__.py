@@ -1,0 +1,2 @@
+from .mesh import make_mesh  # noqa: F401
+from .mix import MixConfig, MixTrainer, mix_average, mix_argmin_kld  # noqa: F401

@@ -1,0 +1,32 @@
+"""Device mesh helpers.
+
+The reference scales out over Hadoop mappers + a Netty parameter-server fleet
+(ref: SURVEY.md §2.18). TPU-native, the workers are devices in a
+jax.sharding.Mesh and synchronization is XLA collectives over ICI (single
+slice) / DCN (multi-slice) — no TCP path exists.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+WORKER_AXIS = "workers"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = WORKER_AXIS,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D data-parallel mesh over the available devices.
+
+    Multi-host note: jax.devices() returns the global device list under
+    multi-process JAX, so the same code scales from 1 chip to a multi-host pod
+    with DCN collectives inserted by XLA automatically.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))

@@ -1,0 +1,177 @@
+"""Collective model mixing — the TPU-native replacement of the MIX subsystem.
+
+The reference's MIX protocol (ref: SURVEY.md §2.18; mix/client/MixClient.java:48-173,
+mixserv/.../MixServerHandler.java:54-158) is an asynchronous, feature-sharded
+parameter server over Netty TCP: clients push (weight, covar, deltaUpdates)
+when a feature's local update count crosses `mixThreshold`, servers keep
+per-feature partial aggregates and push back the global mean when the clock
+difference crosses `syncThreshold`.
+
+Under synchronous SPMD on a TPU mesh the whole TCP path collapses into
+collectives inside one jitted step:
+
+- each device trains a full model replica on its data shard (the Hadoop-mapper
+  analog), with per-feature update counts tracked since the last mix;
+- every `mix_every` blocks, replicas are averaged over the mesh axis with one
+  of the reference's two reduction operators:
+    * `average`   — delta-weighted arithmetic mean
+                    sum(w * delta) / sum(delta)          (ref: PartialAverage.java:43-67)
+    * `argmin_kld` — precision-weighted mean
+                    sum(w/cov) / sum(1/cov), cov' = 1/sum(1/cov)
+                                                        (ref: PartialArgminKLD.java:43-63)
+- features untouched on every replica keep their local value (the server never
+  saw them — exact analog of threshold-gated pushes);
+- the cancel/staleness machinery (MixClient.java:145-166) is unnecessary:
+  synchronous collectives cannot observe stale contributions.
+
+ICI carries the psum on-pod; multi-slice/multi-host runs get DCN collectives
+from XLA with the same program (scaling-book recipe: mesh + shardings, let XLA
+insert the collectives).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..core.engine import DELTA_SLOT, Rule, make_train_fn
+from ..core.state import LinearState, init_linear_state
+from .mesh import WORKER_AXIS, make_mesh
+
+
+def mix_average(weights, delta_upd, axis_name: str = WORKER_AXIS):
+    """Delta-weighted arithmetic mean across the mesh axis
+    (ref: PartialAverage.java getWeight = scaledSumWeights/totalUpdates)."""
+    total = jax.lax.psum(delta_upd, axis_name)
+    wsum = jax.lax.psum(weights * delta_upd, axis_name)
+    return jnp.where(total > 0.0, wsum / jnp.maximum(total, 1.0), weights), total
+
+
+def mix_argmin_kld(weights, covars, delta_upd, axis_name: str = WORKER_AXIS):
+    """Precision-weighted (inverse-variance) mean across the mesh axis
+    (ref: PartialArgminKLD.java:43-63, ensemble/ArgminKLDistanceUDAF.java:28-90)."""
+    total = jax.lax.psum(delta_upd, axis_name)
+    inv = 1.0 / covars
+    sum_inv = jax.lax.psum(inv, axis_name)
+    sum_wdiv = jax.lax.psum(weights * inv, axis_name)
+    mixed_w = jnp.where(total > 0.0, sum_wdiv / sum_inv, weights)
+    mixed_cov = jnp.where(total > 0.0, 1.0 / sum_inv, covars)
+    return mixed_w, mixed_cov, total
+
+
+@dataclass(frozen=True)
+class MixConfig:
+    mix_every: int = 1  # mix after this many blocks (clock/sync analog)
+    reduction: str = "auto"  # average | argmin_kld | auto (covariance -> argmin_kld,
+    # mirroring the reference's event selection for covariance learners)
+    axis_name: str = WORKER_AXIS
+
+
+class MixTrainer:
+    """Data-parallel trainer: N replicas on an N-device mesh with periodic
+    collective mixing. The device axis is materialized as a leading [n_dev]
+    axis on every state leaf, sharded over the mesh.
+    """
+
+    def __init__(self, rule: Rule, hyper: dict, dims: int, mesh: Optional[Mesh] = None,
+                 config: MixConfig = MixConfig(), mode: str = "minibatch"):
+        self.rule = rule
+        self.hyper = hyper
+        self.dims = dims
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.config = config
+        reduction = config.reduction
+        if reduction == "auto":
+            reduction = "argmin_kld" if rule.use_covariance else "average"
+        self.reduction = reduction
+        self.n_dev = self.mesh.devices.size
+        axis = config.axis_name
+
+        local_fn = make_train_fn(rule, hyper, mode=mode, track_deltas=True)
+
+        def device_step(state: LinearState, indices, values, labels):
+            # state leaves carry a leading [1] device axis inside shard_map
+            st = jax.tree.map(lambda x: x[0], state)
+            blocks = (indices[0], values[0], labels[0])  # [k, B, ...]
+
+            def body(s, blk):
+                s, loss = local_fn(s, *blk)
+                return s, loss
+
+            st, losses = jax.lax.scan(body, st, blocks)
+            # ---- mix ----
+            delta = st.slots[DELTA_SLOT]
+            if self.reduction == "argmin_kld":
+                w, cov, _ = mix_argmin_kld(st.weights, st.covars, delta, axis)
+                st = st.replace(weights=w, covars=cov)
+            else:
+                w, _ = mix_average(st.weights, delta, axis)
+                st = st.replace(weights=w)
+            st = st.replace(slots={**st.slots, DELTA_SLOT: jnp.zeros_like(delta)})
+            loss_sum = jax.lax.psum(jnp.sum(losses), axis)
+            return jax.tree.map(lambda x: x[None], st), loss_sum
+
+        spec_state = jax.tree.map(lambda _: P(self.config.axis_name),
+                                  jax.eval_shape(self._init_abstract))
+        self._step = jax.jit(
+            jax.shard_map(
+                device_step,
+                mesh=self.mesh,
+                in_specs=(spec_state, P(axis), P(axis), P(axis)),
+                out_specs=(spec_state, P()),
+            ),
+            donate_argnums=(0,),
+        )
+
+    def _init_abstract(self):
+        return self._init_one()
+
+    def _init_one(self) -> LinearState:
+        return init_linear_state(
+            self.dims,
+            use_covariance=self.rule.use_covariance,
+            slot_names=tuple(self.rule.slot_names) + (DELTA_SLOT,),
+            global_names=self.rule.global_names,
+        )
+
+    def init(self) -> LinearState:
+        """Replicated initial state with a leading device axis, sharded over
+        the mesh."""
+        one = self._init_one()
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (self.n_dev,) + x.shape), one)
+        sharding = NamedSharding(self.mesh, P(self.config.axis_name))
+        return jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(
+                self.mesh, P(*( (self.config.axis_name,) + (None,) * (x.ndim - 1) )))),
+            stacked)
+
+    def step(self, state: LinearState, indices, values, labels):
+        """One mixed step. indices/values/labels: [n_dev, k, B, ...] — each
+        device consumes k blocks then the replicas mix."""
+        return self._step(state, indices, values, labels)
+
+    def shard_blocks(self, indices, values, labels):
+        """Host helper: split [n_dev * k, B, ...] host blocks into the
+        [n_dev, k, B, ...] layout."""
+        nk = indices.shape[0]
+        k = nk // self.n_dev
+        if k * self.n_dev != nk:
+            raise ValueError(f"{nk} blocks not divisible by {self.n_dev} devices")
+        reshape = lambda a: a.reshape((self.n_dev, k) + a.shape[1:])
+        return reshape(indices), reshape(values), reshape(labels)
+
+    def final_state(self, state: LinearState) -> LinearState:
+        """Collapse the device axis after the trailing mix: weights/covars are
+        identical across replicas; touched/delta merge by max/sum."""
+        host = jax.device_get(state)
+        merged = jax.tree.map(lambda x: x[0], host)
+        merged = merged.replace(touched=np.max(np.asarray(host.touched), axis=0))
+        return merged
