@@ -88,7 +88,12 @@ def iter_blocks(
     batch_size: int,
     width: Optional[int] = None,
 ):
-    """Yield fixed-shape FeatureBlocks over a dataset (last block padded)."""
+    """Yield fixed-shape FeatureBlocks over a dataset.
+
+    The final partial block is emitted at its true size (one extra compiled
+    shape) rather than padded with fake rows — fake rows would corrupt global
+    scalars (w0, running target stats) and the example counter `t`.
+    """
     n = len(idx_rows)
     if width is None:
         max_nnz = max((len(r) for r in idx_rows), default=1)
@@ -101,7 +106,7 @@ def iter_blocks(
             labels[start:end],
             dims,
             width=width,
-            batch_size=batch_size,
+            batch_size=end - start,
         )
 
 

@@ -1,0 +1,331 @@
+"""Factorization Machines: train_fm / fm_predict.
+
+Mirrors the reference FM subsystem (ref: fm/FactorizationMachineUDTF.java:115-560,
+fm/FactorizationMachineModel.java:118-300, fm/FMHyperParameters.java:30-110):
+
+- prediction  p = w0 + sum_i w_i x_i + 1/2 sum_f [(sum_i V_if x_i)^2 - sum_i V_if^2 x_i^2]
+- dloss: classification (sigmoid(p*y) - 1)*y with y in {-1,1}; regression
+  p clamped to [min_target, max_target], p - y
+- SGD updates with per-group L2: w0 -= eta*(g + 2*lambda_w0*w0),
+  wi -= eta*(g*xi + 2*lambda_w*wi),
+  Vif -= eta*(g*(xi*sumVfX_f - Vif*xi^2) + 2*lambda_Vf*Vif)
+- adaptive regularization (-adareg): a validation fraction of rows updates the
+  lambdas instead of theta (ref: trainLambda, FactorizationMachineUDTF.java:404-412,
+  FactorizationMachineModel.java:253-300)
+- multi-epoch: the reference serializes rows to a NioStatefullSegment temp
+  file and replays in close() (ref: :291-332, :521-559); TPU-first the staged
+  FeatureBlocks simply re-run, with the same ConversionState early exit.
+
+TPU-first design: V is one [D, k] HBM table; a row's factor block is a [K, k]
+gather, sumVfX is a matvec, and the V update is one fused outer-product —
+batched across B rows in minibatch mode (the bench hot path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..constants import DEFAULT_NUM_FEATURES
+from ..core.batch import iter_blocks, pad_to_bucket, shuffle_rows
+from ..ops.convergence import ConversionState
+from ..ops.eta import EtaEstimator, get_eta
+from ..utils.options import Options
+from .base import FeatureRows, _stage_rows, base_options
+
+DOUBLE_MIN = -1.7976931348623157e308  # mirrors Double.MIN_VALUE default semantics:
+# the reference's minTarget default is Double.MIN_VALUE (smallest positive!),
+# maxTarget Double.MAX_VALUE — i.e. clamping is effectively [tiny, huge] unless
+# the user passes -min/-max. We default to no-op bounds instead (saner, and
+# identical whenever the user sets them explicitly).
+
+
+@struct.dataclass
+class FMState:
+    w0: jnp.ndarray  # []
+    w: jnp.ndarray  # [D]
+    v: jnp.ndarray  # [D, k]
+    lambda_w0: jnp.ndarray  # []
+    lambda_w: jnp.ndarray  # []
+    lambda_v: jnp.ndarray  # [k]
+    touched: jnp.ndarray  # [D] int8
+    step: jnp.ndarray  # [] int32
+
+
+@dataclass(frozen=True)
+class FMHyper:
+    factors: int = 5
+    classification: bool = False
+    lambda0: float = 0.01
+    sigma: float = 0.1
+    min_target: float = -3.0e38
+    max_target: float = 3.0e38
+    eta: EtaEstimator = EtaEstimator("invscaling", 0.05, power_t=0.1)
+    adareg: bool = False
+    va_ratio: float = 0.05
+    seed: int = 31
+
+
+def init_fm_state(dims: int, hyper: FMHyper) -> FMState:
+    k = hyper.factors
+    key = jax.random.PRNGKey(hyper.seed)
+    # 'random' init: uniform in [-maxval..maxval]-ish; 'gaussian': N(0, sigma).
+    # We use gaussian * sigma for both (the reference default for
+    # classification; regression's 'random' differs only in distribution shape,
+    # ref: fm/VInitScheme.java).
+    v = jax.random.normal(key, (dims, k), dtype=jnp.float32) * hyper.sigma
+    return FMState(
+        w0=jnp.zeros((), jnp.float32),
+        w=jnp.zeros((dims,), jnp.float32),
+        v=v,
+        lambda_w0=jnp.asarray(hyper.lambda0, jnp.float32),
+        lambda_w=jnp.asarray(hyper.lambda0, jnp.float32),
+        lambda_v=jnp.full((k,), hyper.lambda0, jnp.float32),
+        touched=jnp.zeros((dims,), jnp.int8),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def _row_predict(w0, wg, vg, val):
+    """p and sumVfX for one row from gathered slices (padding lanes are 0)."""
+    linear = jnp.sum(wg * val)
+    vx = vg * val[:, None]  # [K, k]
+    sum_vfx = jnp.sum(vx, axis=0)  # [k]
+    sum_v2x2 = jnp.sum(vx * vx, axis=0)  # [k]
+    p = w0 + linear + 0.5 * jnp.sum(sum_vfx * sum_vfx - sum_v2x2)
+    return p, sum_vfx
+
+
+def _dloss_and_loss(p, y, hyper: FMHyper):
+    if hyper.classification:
+        # dloss = (sigmoid(p*y) - 1)*y; loss = log(1 + exp(-p*y))
+        z = p * y
+        g = (jax.nn.sigmoid(z) - 1.0) * y
+        loss = jnp.logaddexp(0.0, -z)
+    else:
+        pc = jnp.clip(p, hyper.min_target, hyper.max_target)
+        g = pc - y
+        loss = 0.5 * g * g  # squared loss for cv tracking
+    return g, loss
+
+
+def make_fm_step(hyper: FMHyper, mode: str = "minibatch"):
+    """Jitted FM block update. scan = reference-exact sequential; minibatch =
+    accumulate-then-apply against block-start parameters."""
+
+    def row_deltas(state: FMState, idx, val, y, t):
+        eta = hyper.eta.eta(t)
+        wg = state.w.at[idx].get(mode="fill", fill_value=0.0)
+        vg = state.v.at[idx].get(mode="fill", fill_value=0.0)
+        p, sum_vfx = _row_predict(state.w0, wg, vg, val)
+        g, loss = _dloss_and_loss(p, y, hyper)
+        dw0 = -eta * (g + 2.0 * state.lambda_w0 * state.w0)
+        dw = -eta * (g * val + 2.0 * state.lambda_w * wg)
+        x2 = val * val
+        grad_v = val[:, None] * sum_vfx[None, :] - vg * x2[:, None]
+        dv = -eta * (g * grad_v + 2.0 * state.lambda_v[None, :] * vg)
+        return dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta
+
+    def lambda_deltas(state: FMState, idx, val, y, t, wg, vg, g, sum_vfx, eta):
+        # adaptive regularization (ref: FactorizationMachineModel.java:253-300)
+        dl_w0 = -eta * g * (-2.0 * eta * state.w0)
+        sum_wx = jnp.sum(wg * val)
+        dl_w = -eta * g * (-2.0 * eta * sum_wx)
+        grad_v = val[:, None] * sum_vfx[None, :] - vg * (val * val)[:, None]
+        v_dash = vg - eta * (g * grad_v + 2.0 * state.lambda_v[None, :] * vg)
+        sum_f_dash = jnp.sum(val[:, None] * v_dash, axis=0)
+        sum_f = sum_vfx
+        sum_f_dash_f = jnp.sum(val[:, None] * v_dash * val[:, None] * vg, axis=0)
+        dl_v = -eta * g * (-2.0 * eta * (sum_f_dash * sum_f - sum_f_dash_f))
+        return dl_w0, dl_w, dl_v
+
+    def scan_step(state: FMState, indices, values, labels, va_mask):
+        def body(st: FMState, row):
+            idx, val, y, is_va = row
+            t = (st.step + 1).astype(jnp.float32)
+            dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta = row_deltas(st, idx, val, y, t)
+            theta = 1.0 - is_va
+            st2 = st.replace(
+                w0=st.w0 + theta * dw0,
+                w=st.w.at[idx].add(theta * dw, mode="drop"),
+                v=st.v.at[idx].add(theta * dv, mode="drop"),
+                touched=st.touched.at[idx].max(
+                    jnp.broadcast_to((theta > 0).astype(jnp.int8), idx.shape),
+                    mode="drop"),
+                step=st.step + 1,
+            )
+            if hyper.adareg:
+                dl_w0, dl_w, dl_v = lambda_deltas(st, idx, val, y, t, wg, vg, g,
+                                                  sum_vfx, eta)
+                st2 = st2.replace(
+                    lambda_w0=jnp.maximum(0.0, st2.lambda_w0 + is_va * dl_w0),
+                    lambda_w=jnp.maximum(0.0, st2.lambda_w + is_va * dl_w),
+                    lambda_v=jnp.maximum(0.0, st2.lambda_v + is_va * dl_v),
+                )
+            return st2, theta * loss
+
+        state, losses = jax.lax.scan(body, state, (indices, values, labels, va_mask))
+        return state, jnp.sum(losses)
+
+    def minibatch_step(state: FMState, indices, values, labels, va_mask):
+        b = indices.shape[0]
+        ts = (state.step + 1 + jnp.arange(b)).astype(jnp.float32)
+
+        def per_row(idx, val, y, t):
+            return row_deltas(state, idx, val, y, t)
+
+        dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta = jax.vmap(per_row)(
+            indices, values, labels, ts)
+        theta = (1.0 - va_mask)  # [B]
+        new_state = state.replace(
+            w0=state.w0 + jnp.sum(theta * dw0),
+            w=state.w.at[indices].add(theta[:, None] * dw, mode="drop"),
+            v=state.v.at[indices].add(theta[:, None, None] * dv, mode="drop"),
+            touched=state.touched.at[indices].max(
+                jnp.broadcast_to((theta > 0).astype(jnp.int8)[:, None], indices.shape),
+                mode="drop"),
+            step=state.step + b,
+        )
+        if hyper.adareg:
+            def per_row_lambda(idx, val, y, t, wg_, vg_, g_, sv_, eta_):
+                return lambda_deltas(state, idx, val, y, t, wg_, vg_, g_, sv_, eta_)
+
+            dl_w0, dl_w, dl_v = jax.vmap(per_row_lambda)(
+                indices, values, labels, ts, wg, vg, g, sum_vfx, eta)
+            vam = va_mask
+            new_state = new_state.replace(
+                lambda_w0=jnp.maximum(0.0, state.lambda_w0 + jnp.sum(vam * dl_w0)),
+                lambda_w=jnp.maximum(0.0, state.lambda_w + jnp.sum(vam * dl_w)),
+                lambda_v=jnp.maximum(0.0, state.lambda_v
+                                     + jnp.sum(vam[:, None] * dl_v, axis=0)),
+            )
+        return new_state, jnp.sum(theta * loss)
+
+    return jax.jit(scan_step if mode == "scan" else minibatch_step, donate_argnums=(0,))
+
+
+@jax.jit
+def _fm_scores(state: FMState, indices, values):
+    def one(idx, val):
+        wg = state.w.at[idx].get(mode="fill", fill_value=0.0)
+        vg = state.v.at[idx].get(mode="fill", fill_value=0.0)
+        p, _ = _row_predict(state.w0, wg, vg, val)
+        return p
+
+    return jax.vmap(one)(indices, values)
+
+
+@dataclass
+class TrainedFMModel:
+    state: FMState
+    hyper: FMHyper
+    dims: int
+
+    def predict(self, features: FeatureRows) -> np.ndarray:
+        idx_rows, val_rows = _stage_rows(features, self.dims)
+        n = len(idx_rows)
+        width = pad_to_bucket(max((len(r) for r in idx_rows), default=1))
+        out = []
+        for blk in iter_blocks(idx_rows, val_rows, np.zeros(n), self.dims, 4096, width):
+            out.append(np.asarray(_fm_scores(self.state, blk.indices, blk.values)))
+        return np.concatenate(out)[:n]
+
+    def model_rows(self):
+        """(feature, Wi, Vi[factors]) rows + the w0 bias row (feature 0 carries
+        w0, ref: forwardAsIntFeature FactorizationMachineUDTF.java:446-519)."""
+        touched = np.asarray(self.state.touched) != 0
+        feats = np.nonzero(touched)[0].astype(np.int64)
+        w = np.asarray(self.state.w)[feats]
+        v = np.asarray(self.state.v)[feats]
+        return float(self.state.w0), feats, w, v
+
+
+def _fm_options() -> Options:
+    o = base_options()
+    o.add("c", "classification", False, "Act as classification")
+    o.add("seed", None, True, "Seed value [default: 31]", default=31, type=int)
+    o.add("p", "num_features", True, "The size of feature dimensions", type=int)
+    o.add("factor", "factors", True, "Number of latent factors [default: 5]",
+          default=5, type=int)
+    o.add("sigma", None, True, "Stddev for initializing V [default: 0.1]",
+          default=0.1, type=float)
+    o.add("lambda0", "lambda", True, "Regularization lambda [default: 0.01]",
+          default=0.01, type=float)
+    o.add("min", "min_target", True, "Min target value", type=float)
+    o.add("max", "max_target", True, "Max target value", type=float)
+    o.add("eta", None, True, "Fixed learning rate", type=float)
+    o.add("eta0", None, True, "Initial learning rate [default 0.05]", default=0.05,
+          type=float)
+    o.add("t", "total_steps", True, "Total training steps", type=int)
+    o.add("power_t", None, True, "Inverse-scaling exponent [default 0.1]",
+          default=0.1, type=float)
+    o.add("adareg", "adaptive_regularizaion", False, "Adaptive regularization")
+    o.add("va_ratio", "validation_ratio", True, "Validation ratio [default 0.05]",
+          default=0.05, type=float)
+    o.add("int_feature", "feature_as_integer", False, "Parse features as integers")
+    return o
+
+
+def train_fm(features: FeatureRows, targets, options: Optional[str] = None,
+             **kw) -> TrainedFMModel:
+    cl = _fm_options().parse(options, "train_fm")
+    dims = cl.get_int("dims") or cl.get_int("p") or DEFAULT_NUM_FEATURES
+    hyper = FMHyper(
+        factors=cl.get_int("factor", 5),
+        classification=cl.has("c"),
+        lambda0=cl.get_float("lambda0", 0.01),
+        sigma=cl.get_float("sigma", 0.1),
+        min_target=cl.get_float("min", -3.0e38),
+        max_target=cl.get_float("max", 3.0e38),
+        eta=get_eta(cl, 0.05),
+        adareg=cl.has("adareg"),
+        va_ratio=cl.get_float("va_ratio", 0.05),
+        seed=cl.get_int("seed", 31),
+    )
+    targets = np.asarray(targets, dtype=np.float32)
+    if hyper.classification:
+        targets = np.where(targets > 0, 1.0, -1.0).astype(np.float32)
+    idx_rows, val_rows = _stage_rows(features, dims)
+    n = len(idx_rows)
+    width = pad_to_bucket(max((len(r) for r in idx_rows), default=1))
+    mini_batch = cl.get_int("mini_batch", 1)
+    mode = "minibatch" if mini_batch > 1 else "scan"
+    block = mini_batch if mode == "minibatch" else cl.get_int("block_size", 4096)
+    iters = cl.get_int("iters", 1)
+    step = make_fm_step(hyper, mode)
+    state = init_fm_state(dims, hyper)
+    rng = np.random.RandomState(hyper.seed)
+    conv = ConversionState(not cl.has("disable_cv"), cl.get_float("cv_rate", 0.005))
+    for it in range(max(1, iters)):
+        if cl.has("shuffle") and it > 0:
+            idx_rows, val_rows, targets = shuffle_rows(idx_rows, val_rows, targets,
+                                                       hyper.seed + it)
+        epoch_loss = 0.0
+        for blk in iter_blocks(idx_rows, val_rows, targets, dims, block, width):
+            va = (rng.rand(blk.batch_size) < hyper.va_ratio).astype(np.float32) \
+                if hyper.adareg else np.zeros(blk.batch_size, np.float32)
+            state, loss = step(state, blk.indices, blk.values, blk.labels, va)
+            epoch_loss += float(loss)
+        conv.incr_loss(epoch_loss)
+        if iters > 1 and conv.is_converged(n):
+            break
+    return TrainedFMModel(state=state, hyper=hyper, dims=dims)
+
+
+def fm_predict(w0: float, w: Sequence[float], v: Sequence[Sequence[float]],
+               feats: Sequence[int], xs: Sequence[float]) -> float:
+    """`fm_predict` UDAF equivalent: score one row from model rows
+    (ref: fm/FMPredictGenericUDAF.java) — p = w0 + sum w_i x_i + pairwise V term."""
+    w = np.asarray(w, dtype=np.float64)
+    v = np.asarray(v, dtype=np.float64)
+    x = np.asarray(xs, dtype=np.float64)
+    linear = float(np.sum(w * x))
+    vx = v * x[:, None]
+    s = np.sum(vx, axis=0)
+    s2 = np.sum(vx * vx, axis=0)
+    return float(w0 + linear + 0.5 * np.sum(s * s - s2))
