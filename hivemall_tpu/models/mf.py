@@ -1,0 +1,440 @@
+"""Matrix Factorization: train_mf_sgd / train_mf_adagrad / train_bprmf,
+plus mf_predict / bprmf_predict.
+
+Mirrors the reference MF subsystem (ref: mf/OnlineMatrixFactorizationUDTF.java:92-380,
+mf/MatrixFactorizationSGDUDTF.java:33-65, mf/MatrixFactorizationAdaGradUDTF.java:34-125,
+mf/BPRMatrixFactorizationUDTF.java:65-416, mf/FactorizedModel.java:45-120):
+
+- rating model  r̂ = mu + Bu + Bi + Pu·Qi  (bias clause optional)
+- SGD:      Qi += eta*(err*Pu - lambda*Qi); Pu += eta*(err*Qi - lambda*Pu)
+            (both against the pre-update "probe" copies, ref: :280-296)
+- AdaGrad:  per-element accumulated squared gradients with the x100 scaling
+            trick, eta = eta0/sqrt(eps + G) (ref: MatrixFactorizationAdaGradUDTF.java:111-123)
+- BPR:      triple (u, i, j): x_uij = (Bi + Pu·Qi) - (Bj + Pu·Qj),
+            dloss in {sigmoid, logistic, lnLogistic};
+            Pu += eta*(dloss*(Qi - Qj) - regU*Pu); Qi += eta*(dloss*Pu - regI*Qi);
+            Qj += eta*(-dloss*Pu - regJ*Qj); item biases likewise
+            (ref: BPRMatrixFactorizationUDTF.java:311-416)
+
+TPU-first: user/item tables are dense [U, k]/[I, k] HBM embedding tables
+(replacing IntOpenHashMap<Rating[]>); a training row is two row-gathers, the
+update two row-scatter-adds — batched across B rows in minibatch mode. Epoch
+replay re-runs staged arrays (replaces the 64KiB NIO disk spill, ref: :92,203).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..ops.convergence import ConversionState
+from ..ops.eta import EtaEstimator, get_eta
+from ..utils.options import Options
+
+
+@struct.dataclass
+class MFState:
+    P: jnp.ndarray  # [U, k]
+    Q: jnp.ndarray  # [I, k]
+    Bu: jnp.ndarray  # [U]
+    Bi: jnp.ndarray  # [I]
+    mu: jnp.ndarray  # []
+    P_gg: Optional[jnp.ndarray]  # [U, k] adagrad accumulators (scaled)
+    Q_gg: Optional[jnp.ndarray]
+    touched_u: jnp.ndarray  # [U] int8
+    touched_i: jnp.ndarray  # [I] int8
+    step: jnp.ndarray  # [] int32
+
+
+@dataclass(frozen=True)
+class MFHyper:
+    factor: int = 10
+    lambda_: float = 0.03
+    mu: float = 0.0
+    update_mean: bool = False
+    use_bias: bool = True
+    rankinit: str = "random"
+    maxval: float = 1.0
+    min_init_stddev: float = 0.1
+    eta: EtaEstimator = EtaEstimator("invscaling", 0.2, power_t=0.1)
+    # adagrad
+    adagrad: bool = False
+    eps: float = 1.0
+    scaling: float = 100.0
+    seed: int = 31
+
+
+def init_mf_state(num_users: int, num_items: int, hyper: MFHyper) -> MFState:
+    k = hyper.factor
+    key = jax.random.PRNGKey(hyper.seed)
+    ku, ki = jax.random.split(key)
+    if hyper.rankinit == "gaussian":
+        P = jax.random.normal(ku, (num_users, k)) * hyper.min_init_stddev
+        Q = jax.random.normal(ki, (num_items, k)) * hyper.min_init_stddev
+    else:  # 'random' uniform in [0, maxval/k-ish] (ref: Rating.rand init)
+        P = jax.random.uniform(ku, (num_users, k), maxval=hyper.maxval)
+        Q = jax.random.uniform(ki, (num_items, k), maxval=hyper.maxval)
+    gg = (jnp.zeros((num_users, k)), jnp.zeros((num_items, k))) if hyper.adagrad \
+        else (None, None)
+    return MFState(
+        P=P.astype(jnp.float32), Q=Q.astype(jnp.float32),
+        Bu=jnp.zeros((num_users,), jnp.float32),
+        Bi=jnp.zeros((num_items,), jnp.float32),
+        mu=jnp.asarray(hyper.mu, jnp.float32),
+        P_gg=gg[0], Q_gg=gg[1],
+        touched_u=jnp.zeros((num_users,), jnp.int8),
+        touched_i=jnp.zeros((num_items,), jnp.int8),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_mf_step(hyper: MFHyper, mode: str = "minibatch"):
+    """Rating-MF block update over (users [B], items [B], ratings [B])."""
+
+    def row_deltas(st: MFState, u, i, r, t):
+        eta = hyper.eta.eta(t)
+        Pu = st.P[u]
+        Qi = st.Q[i]
+        bu = st.Bu[u] if hyper.use_bias else 0.0
+        bi = st.Bi[i] if hyper.use_bias else 0.0
+        pred = st.mu + bu + bi + jnp.dot(Pu, Qi)
+        err = r - pred
+        lam = hyper.lambda_
+        gq = err * Pu - lam * Qi
+        gp = err * Qi - lam * Pu
+        if hyper.adagrad:
+            # scaled accumulator trick (ref: MatrixFactorizationAdaGradUDTF.java:111-123)
+            ggp = st.P_gg[u] + gp * (gp / hyper.scaling)
+            ggq = st.Q_gg[i] + gq * (gq / hyper.scaling)
+            eta_p = hyper.eta.eta0 / jnp.sqrt(hyper.eps + ggp * hyper.scaling)
+            eta_q = hyper.eta.eta0 / jnp.sqrt(hyper.eps + ggq * hyper.scaling)
+            dP, dQ = eta_p * gp, eta_q * gq
+            dggp, dggq = gp * (gp / hyper.scaling), gq * (gq / hyper.scaling)
+        else:
+            dP, dQ = eta * gp, eta * gq
+            dggp = dggq = None
+        dbu = eta * (err - lam * bu) if hyper.use_bias else 0.0
+        dbi = eta * (err - lam * bi) if hyper.use_bias else 0.0
+        dmu = eta * err if (hyper.use_bias and hyper.update_mean) else 0.0
+        loss = err * err
+        return dP, dQ, dbu, dbi, dmu, dggp, dggq, loss
+
+    def apply(st: MFState, u, i, dP, dQ, dbu, dbi, dmu, dggp, dggq, nb):
+        st = st.replace(
+            P=st.P.at[u].add(dP),
+            Q=st.Q.at[i].add(dQ),
+            touched_u=st.touched_u.at[u].set(1),
+            touched_i=st.touched_i.at[i].set(1),
+            step=st.step + nb,
+        )
+        if hyper.use_bias:
+            st = st.replace(Bu=st.Bu.at[u].add(dbu), Bi=st.Bi.at[i].add(dbi),
+                            mu=st.mu + jnp.sum(dmu))
+        if hyper.adagrad:
+            st = st.replace(P_gg=st.P_gg.at[u].add(dggp), Q_gg=st.Q_gg.at[i].add(dggq))
+        return st
+
+    def scan_step(state: MFState, users, items, ratings):
+        def body(st, row):
+            u, i, r = row
+            t = (st.step + 1).astype(jnp.float32)
+            dP, dQ, dbu, dbi, dmu, dggp, dggq, loss = row_deltas(st, u, i, r, t)
+            return apply(st, u, i, dP, dQ, dbu, dbi, dmu, dggp, dggq, 1), loss
+
+        state, losses = jax.lax.scan(body, state, (users, items, ratings))
+        return state, jnp.sum(losses)
+
+    def minibatch_step(state: MFState, users, items, ratings):
+        b = users.shape[0]
+        ts = (state.step + 1 + jnp.arange(b)).astype(jnp.float32)
+        dP, dQ, dbu, dbi, dmu, dggp, dggq, loss = jax.vmap(
+            lambda u, i, r, t: row_deltas(state, u, i, r, t))(users, items, ratings, ts)
+        return apply(state, users, items, dP, dQ, dbu, dbi, dmu, dggp, dggq, b), \
+            jnp.sum(loss)
+
+    return jax.jit(scan_step if mode == "scan" else minibatch_step, donate_argnums=(0,))
+
+
+def make_bpr_step(hyper: "BPRHyper", mode: str = "minibatch"):
+    def dloss_fn(x):
+        if hyper.loss == "sigmoid":
+            return 1.0 / (1.0 + jnp.exp(x))
+        if hyper.loss == "logistic":
+            s = jax.nn.sigmoid(x)
+            return s * (1.0 - s)
+        # lnLogistic (default): e^-x / (1 + e^-x) = sigmoid(-x)
+        return jax.nn.sigmoid(-x)
+
+    def loss_fn(x):
+        if hyper.loss == "lnLogistic":
+            return jnp.logaddexp(0.0, -x)  # -ln sigmoid(x)
+        return -x  # proxy
+
+    def row_deltas(st: MFState, u, i, j, t):
+        eta = hyper.eta.eta(t)
+        Pu, Qi, Qj = st.P[u], st.Q[i], st.Q[j]
+        bi = st.Bi[i] if hyper.use_bias else 0.0
+        bj = st.Bi[j] if hyper.use_bias else 0.0
+        x_uij = (bi + jnp.dot(Pu, Qi)) - (bj + jnp.dot(Pu, Qj))
+        g = dloss_fn(x_uij)
+        dP = eta * (g * (Qi - Qj) - hyper.reg_u * Pu)
+        dQi = eta * (g * Pu - hyper.reg_i * Qi)
+        dQj = eta * (-g * Pu - hyper.reg_j * Qj)
+        dbi = eta * (g - hyper.reg_bias * bi) if hyper.use_bias else 0.0
+        dbj = eta * (-g - hyper.reg_bias * bj) if hyper.use_bias else 0.0
+        return dP, dQi, dQj, dbi, dbj, loss_fn(x_uij)
+
+    def apply(st, u, i, j, dP, dQi, dQj, dbi, dbj, nb):
+        st = st.replace(
+            P=st.P.at[u].add(dP),
+            Q=st.Q.at[i].add(dQi).at[j].add(dQj),
+            touched_u=st.touched_u.at[u].set(1),
+            touched_i=st.touched_i.at[i].set(1).at[j].set(1),
+            step=st.step + nb,
+        )
+        if hyper.use_bias:
+            st = st.replace(Bi=st.Bi.at[i].add(dbi).at[j].add(dbj))
+        return st
+
+    def scan_step(state, users, pos, neg):
+        def body(st, row):
+            u, i, j = row
+            t = (st.step + 1).astype(jnp.float32)
+            d = row_deltas(st, u, i, j, t)
+            return apply(st, u, i, j, *d[:-1], 1), d[-1]
+
+        state, losses = jax.lax.scan(body, state, (users, pos, neg))
+        return state, jnp.sum(losses)
+
+    def minibatch_step(state, users, pos, neg):
+        b = users.shape[0]
+        ts = (state.step + 1 + jnp.arange(b)).astype(jnp.float32)
+        dP, dQi, dQj, dbi, dbj, loss = jax.vmap(
+            lambda u, i, j, t: row_deltas(state, u, i, j, t))(users, pos, neg, ts)
+        return apply(state, users, pos, neg, dP, dQi, dQj, dbi, dbj, b), jnp.sum(loss)
+
+    return jax.jit(scan_step if mode == "scan" else minibatch_step, donate_argnums=(0,))
+
+
+@dataclass(frozen=True)
+class BPRHyper:
+    factor: int = 10
+    loss: str = "lnLogistic"
+    reg_u: float = 0.0025
+    reg_i: float = 0.0025
+    reg_j: float = 0.00125
+    reg_bias: float = 0.01
+    use_bias: bool = True
+    rankinit: str = "random"
+    maxval: float = 1.0
+    min_init_stddev: float = 0.1
+    eta: EtaEstimator = EtaEstimator("invscaling", 0.3, power_t=0.1)
+    seed: int = 31
+
+    # adapters so init_mf_state can be reused
+    @property
+    def mu(self):
+        return 0.0
+
+    @property
+    def adagrad(self):
+        return False
+
+
+@dataclass
+class TrainedMFModel:
+    state: MFState
+    use_bias: bool
+
+    def predict(self, users, items) -> np.ndarray:
+        """r̂ = mu + Bu + Bi + Pu·Qi (ref: MFPredictionUDF.java:33)."""
+        u = np.asarray(users, dtype=np.int64)
+        i = np.asarray(items, dtype=np.int64)
+        P = np.asarray(self.state.P)[u]
+        Q = np.asarray(self.state.Q)[i]
+        out = np.sum(P * Q, axis=-1) + float(self.state.mu)
+        if self.use_bias:
+            out = out + np.asarray(self.state.Bu)[u] + np.asarray(self.state.Bi)[i]
+        return out
+
+    def predict_bpr(self, users, items) -> np.ndarray:
+        """BPR score = Bi + Pu·Qi (ref: BPRMFPredictionUDF.java)."""
+        u = np.asarray(users, dtype=np.int64)
+        i = np.asarray(items, dtype=np.int64)
+        out = np.sum(np.asarray(self.state.P)[u] * np.asarray(self.state.Q)[i], axis=-1)
+        if self.use_bias:
+            out = out + np.asarray(self.state.Bi)[i]
+        return out
+
+    def model_rows(self):
+        """(idx, Pu, Qi, Bu, Bi, mu) — the reference's per-index emission
+        (ref: OnlineMatrixFactorizationUDTF close/forward)."""
+        tu = np.nonzero(np.asarray(self.state.touched_u))[0]
+        ti = np.nonzero(np.asarray(self.state.touched_i))[0]
+        return {
+            "users": (tu, np.asarray(self.state.P)[tu], np.asarray(self.state.Bu)[tu]),
+            "items": (ti, np.asarray(self.state.Q)[ti], np.asarray(self.state.Bi)[ti]),
+            "mu": float(self.state.mu),
+        }
+
+
+def _mf_options(bpr: bool = False) -> Options:
+    o = Options()
+    o.add("k", "factor", True, "Number of latent factors [default: 10]", default=10,
+          type=int)
+    o.add("iter", "iterations", True, "Iterations [default: 1]",
+          default=30 if bpr else 1, type=int)
+    o.add("rankinit", None, True, "Init strategy [random, gaussian]", default="random")
+    o.add("maxval", "max_init_value", True, "Max initial value [default: 1.0]",
+          default=1.0, type=float)
+    o.add("min_init_stddev", None, True, "Gaussian init stddev [default: 0.1]",
+          default=0.1, type=float)
+    o.add("disable_cv", "disable_cvtest", False, "Disable convergence check")
+    o.add("cv_rate", "convergence_rate", True, "Convergence rate [default: 0.005]",
+          default=0.005, type=float)
+    o.add("disable_bias", "no_bias", False, "Turn off bias clause")
+    o.add("eta", None, True, "Fixed learning rate", type=float)
+    o.add("eta0", None, True, "Initial learning rate", type=float)
+    o.add("t", "total_steps", True, "Total steps", type=int)
+    o.add("power_t", None, True, "Inverse scaling exponent [default 0.1]",
+          default=0.1, type=float)
+    o.add("boldDriver", "bold_driver", False, "Bold driver eta")
+    o.add("seed", None, True, "Init seed", default=31, type=int)
+    o.add("mini_batch", None, True, "Mini batch size [default 1 = exact scan]",
+          default=1, type=int)
+    if bpr:
+        o.add("loss", "loss_function", True,
+              "Loss [lnLogistic (default), logistic, sigmoid]", default="lnLogistic")
+        o.add("reg", "lambda", True, "Regularization factor [default 0.0025]",
+              default=0.0025, type=float)
+        o.add("reg_u", "reg_user", True, "User regularization", type=float)
+        o.add("reg_i", "reg_item", True, "Positive item regularization", type=float)
+        o.add("reg_j", None, True, "Negative item regularization", type=float)
+        o.add("reg_bias", None, True, "Bias regularization [default 0.01]",
+              default=0.01, type=float)
+    else:
+        o.add("r", "lambda", True, "Regularization factor [default: 0.03]",
+              default=0.03, type=float)
+        o.add("mu", "mean_rating", True, "Mean rating [default: 0.0]", default=0.0,
+              type=float)
+        o.add("update_mean", "update_mu", False, "Update the mean rating")
+        o.add("eps", None, True, "AdaGrad eps [default 1.0]", default=1.0, type=float)
+        o.add("scale", None, True, "AdaGrad scaling [default 100]", default=100.0,
+              type=float)
+    return o
+
+
+def _dims_from(idx, given: Optional[int]) -> int:
+    return given if given is not None else int(np.max(idx)) + 1
+
+
+def _train_rating_mf(users, items, ratings, options: Optional[str], adagrad: bool,
+                     name: str, num_users=None, num_items=None) -> TrainedMFModel:
+    cl = _mf_options().parse(options, name)
+    default_eta0 = 1.0 if adagrad else 0.2
+    hyper = MFHyper(
+        factor=cl.get_int("k", 10),
+        lambda_=cl.get_float("r", 0.03),
+        mu=cl.get_float("mu", 0.0),
+        update_mean=cl.has("update_mean"),
+        use_bias=not cl.has("disable_bias"),
+        rankinit=cl.get("rankinit", "random"),
+        maxval=cl.get_float("maxval", 1.0),
+        min_init_stddev=cl.get_float("min_init_stddev", 0.1),
+        eta=get_eta(cl, default_eta0),
+        adagrad=adagrad,
+        eps=cl.get_float("eps", 1.0),
+        scaling=cl.get_float("scale", 100.0),
+        seed=cl.get_int("seed", 31),
+    )
+    u = np.asarray(users, dtype=np.int32)
+    i = np.asarray(items, dtype=np.int32)
+    r = np.asarray(ratings, dtype=np.float32)
+    state = init_mf_state(_dims_from(u, num_users), _dims_from(i, num_items), hyper)
+    mini_batch = cl.get_int("mini_batch", 1)
+    mode = "minibatch" if mini_batch > 1 else "scan"
+    step = make_mf_step(hyper, mode)
+    iters = cl.get_int("iter", 1)
+    conv = ConversionState(not cl.has("disable_cv"), cl.get_float("cv_rate", 0.005))
+    block = mini_batch if mode == "minibatch" else 8192
+    n = len(u)
+    for it in range(max(1, iters)):
+        epoch_loss = 0.0
+        for s in range(0, n, block):
+            e = min(s + block, n)
+            state, loss = step(state, u[s:e], i[s:e], r[s:e])
+            epoch_loss += float(loss)
+        conv.incr_loss(epoch_loss)
+        if iters > 1 and conv.is_converged(n):
+            break
+    return TrainedMFModel(state=state, use_bias=hyper.use_bias)
+
+
+def train_mf_sgd(users, items, ratings, options: Optional[str] = None, **kw):
+    return _train_rating_mf(users, items, ratings, options, False, "train_mf_sgd", **kw)
+
+
+def train_mf_adagrad(users, items, ratings, options: Optional[str] = None, **kw):
+    return _train_rating_mf(users, items, ratings, options, True, "train_mf_adagrad", **kw)
+
+
+def train_bprmf(users, pos_items, neg_items, options: Optional[str] = None,
+                num_users=None, num_items=None) -> TrainedMFModel:
+    cl = _mf_options(bpr=True).parse(options, "train_bprmf")
+    reg = cl.get_float("reg", 0.0025)
+    reg_i = cl.get_float("reg_i") if cl.has("reg_i") else reg
+    hyper = BPRHyper(
+        factor=cl.get_int("k", 10),
+        loss=cl.get("loss", "lnLogistic"),
+        reg_u=cl.get_float("reg_u") if cl.has("reg_u") else reg,
+        reg_i=reg_i,
+        reg_j=cl.get_float("reg_j") if cl.has("reg_j") else reg_i / 2.0,
+        reg_bias=cl.get_float("reg_bias", 0.01),
+        use_bias=not cl.has("disable_bias"),
+        rankinit=cl.get("rankinit", "random"),
+        maxval=cl.get_float("maxval", 1.0),
+        min_init_stddev=cl.get_float("min_init_stddev", 0.1),
+        eta=get_eta(cl, 0.3),
+        seed=cl.get_int("seed", 31),
+    )
+    u = np.asarray(users, dtype=np.int32)
+    i = np.asarray(pos_items, dtype=np.int32)
+    j = np.asarray(neg_items, dtype=np.int32)
+    nu = _dims_from(u, num_users)
+    ni = _dims_from(np.concatenate([i, j]), num_items)
+    mf_hyper = MFHyper(factor=hyper.factor, rankinit=hyper.rankinit,
+                       maxval=hyper.maxval, min_init_stddev=hyper.min_init_stddev,
+                       seed=hyper.seed)
+    state = init_mf_state(nu, ni, mf_hyper)
+    mini_batch = cl.get_int("mini_batch", 1)
+    mode = "minibatch" if mini_batch > 1 else "scan"
+    step = make_bpr_step(hyper, mode)
+    iters = cl.get_int("iter", 30)
+    conv = ConversionState(not cl.has("disable_cv"), cl.get_float("cv_rate", 0.005))
+    block = mini_batch if mode == "minibatch" else 8192
+    n = len(u)
+    for it in range(max(1, iters)):
+        epoch_loss = 0.0
+        for s in range(0, n, block):
+            e = min(s + block, n)
+            state, loss = step(state, u[s:e], i[s:e], j[s:e])
+            epoch_loss += float(loss)
+        conv.incr_loss(epoch_loss)
+        if iters > 1 and conv.is_converged(n):
+            break
+    return TrainedMFModel(state=state, use_bias=hyper.use_bias)
+
+
+def mf_predict(Pu, Qi, Bu=0.0, Bi=0.0, mu=0.0) -> float:
+    """`mf_predict(Pu, Qi[, Bu, Bi, mu])` (ref: mf/MFPredictionUDF.java:33)."""
+    return float(np.dot(np.asarray(Pu), np.asarray(Qi)) + Bu + Bi + mu)
+
+
+def bprmf_predict(Pu, Qi, Bi=0.0) -> float:
+    """`bprmf_predict(Pu, Qi[, Bi])` (ref: mf/BPRMFPredictionUDF.java)."""
+    return float(np.dot(np.asarray(Pu), np.asarray(Qi)) + Bi)
