@@ -1,0 +1,1 @@
+from .lr_datagen import lr_datagen  # noqa: F401

@@ -1,0 +1,72 @@
+"""`lr_datagen` — synthetic logistic-regression data generator
+(ref: dataset/LogisticRegressionDataGeneratorUDTF.java:47-180).
+
+Options mirror the reference: -n_examples/-n_features/-n_dims(200)/-eps/
+-prob_one/-seed/-dense/-sort/-cl (classification labels)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.options import Options
+
+
+def _options() -> Options:
+    o = Options()
+    o.add("n_examples", None, True, "Number of examples [default: 1000]",
+          default=1000, type=int)
+    o.add("n_features", None, True, "Number of non-zero features per example "
+          "[default: 10]", default=10, type=int)
+    o.add("n_dims", None, True, "Feature dimension [default: 200]", default=200,
+          type=int)
+    o.add("eps", None, True, "Label noise epsilon [default: 3.0]", default=3.0,
+          type=float)
+    o.add("prob_one", "prob_y_1", True, "P(y=1) [default: 0.6]", default=0.6,
+          type=float)
+    o.add("seed", None, True, "Random seed [default: 43]", default=43, type=int)
+    o.add("dense", None, False, "Emit dense feature vectors")
+    o.add("sort", None, False, "Sort feature indices in each row")
+    o.add("cl", "classification", False, "Emit 0/1 labels instead of probabilities")
+    return o
+
+
+def lr_datagen(options: Optional[str] = None):
+    """Returns (features_rows, labels): rows of "idx:value" strings (sparse,
+    default) or dense float arrays (-dense)."""
+    cl = _options().parse(options, "lr_datagen")
+    n = cl.get_int("n_examples", 1000)
+    nf = cl.get_int("n_features", 10)
+    nd = cl.get_int("n_dims", 200)
+    eps = cl.get_float("eps", 3.0)
+    prob_one = cl.get_float("prob_one", 0.6)
+    rng = np.random.RandomState(cl.get_int("seed", 43))
+    dense = cl.has("dense")
+    classification = cl.has("cl")
+
+    rows: List = []
+    labels = np.empty(n, dtype=np.float32)
+    for i in range(n):
+        label = prob_one if not classification else float(rng.rand() < prob_one)
+        y = label if not classification else label
+        labels[i] = y
+        sign = 1.0 if (rng.rand() < prob_one) else -1.0
+        if classification:
+            labels[i] = 1.0 if sign > 0 else 0.0
+        else:
+            labels[i] = float(rng.rand())
+        idx = rng.choice(nd, size=min(nf, nd), replace=False)
+        if cl.has("sort"):
+            idx = np.sort(idx)
+        # feature value correlated with the label plus gaussian noise, the
+        # reference's recipe: x ~ N(mu(label), 1) * eps scaling
+        mu = 1.0 if labels[i] > 0.5 else -1.0
+        vals = (rng.randn(len(idx)) + mu * eps / 3.0).astype(np.float32)
+        if dense:
+            row = np.zeros(nd, dtype=np.float32)
+            row[idx] = vals
+            rows.append(row)
+        else:
+            rows.append([f"{int(j)}:{float(v)}" for j, v in zip(idx, vals)])
+    return rows, labels

@@ -1,0 +1,108 @@
+"""Distance UDFs (ref: knn/distance/*.java).
+
+Scalar/sparse-string variants mirror the reference UDF surface; `*_batch`
+variants are vectorized jnp kernels over dense [N, D] matrices (the TPU-shaped
+path for bulk kNN: one matmul per distance matrix instead of per-pair loops).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.feature import parse_feature
+
+VecLike = Union[Sequence[str], Dict[Union[int, str], float]]
+
+
+def _to_map(v: VecLike) -> Dict:
+    if isinstance(v, dict):
+        return v
+    out = {}
+    for fv in v:
+        name, val = parse_feature(fv)
+        out[name] = out.get(name, 0.0) + val
+    return out
+
+
+def popcnt(x: Union[int, Sequence[int]]) -> int:
+    """popcnt(bigint|array<bigint>) (ref: knn/distance/PopcountUDF.java)."""
+    if isinstance(x, (list, tuple, np.ndarray)):
+        return int(sum(bin(int(v) & 0xFFFFFFFFFFFFFFFF).count("1") for v in x))
+    return bin(int(x) & 0xFFFFFFFFFFFFFFFF).count("1")
+
+
+def hamming_distance(a: Union[int, Sequence[int]], b: Union[int, Sequence[int]]) -> int:
+    """popcnt(a xor b) (ref: knn/distance/HammingDistanceUDF.java)."""
+    if isinstance(a, (list, tuple, np.ndarray)):
+        return int(sum(popcnt(int(x) ^ int(y)) for x, y in zip(a, b)))
+    return popcnt(int(a) ^ int(b))
+
+
+def kld(mu1: float, sigma1: float, mu2: float, sigma2: float) -> float:
+    """KL divergence between two 1-D gaussians (ref: knn/distance/KLDivergenceUDF.java)."""
+    return float(0.5 * (math.log(sigma2 / sigma1) + (sigma1 + (mu1 - mu2) ** 2) / sigma2
+                        - 1.0))
+
+
+def euclid_distance(a: VecLike, b: VecLike) -> float:
+    ma, mb = _to_map(a), _to_map(b)
+    keys = set(ma) | set(mb)
+    return float(math.sqrt(sum((ma.get(k, 0.0) - mb.get(k, 0.0)) ** 2 for k in keys)))
+
+
+def manhattan_distance(a: VecLike, b: VecLike) -> float:
+    ma, mb = _to_map(a), _to_map(b)
+    keys = set(ma) | set(mb)
+    return float(sum(abs(ma.get(k, 0.0) - mb.get(k, 0.0)) for k in keys))
+
+
+def minkowski_distance(a: VecLike, b: VecLike, p: float) -> float:
+    ma, mb = _to_map(a), _to_map(b)
+    keys = set(ma) | set(mb)
+    return float(sum(abs(ma.get(k, 0.0) - mb.get(k, 0.0)) ** p for k in keys) ** (1.0 / p))
+
+
+def cosine_distance(a: VecLike, b: VecLike) -> float:
+    """1 - cosine_similarity (ref: knn/distance/CosineDistanceUDF.java:40)."""
+    from .similarity import cosine_similarity
+
+    return 1.0 - cosine_similarity(a, b)
+
+
+def angular_distance(a: VecLike, b: VecLike) -> float:
+    """acos(cos_sim)/pi (ref: knn/distance/AngularDistanceUDF.java)."""
+    from .similarity import cosine_similarity
+
+    cos = min(1.0, max(-1.0, cosine_similarity(a, b)))
+    return float(math.acos(cos) / math.pi)
+
+
+def jaccard_distance(a: Union[int, Sequence], b: Union[int, Sequence],
+                     k: int = 128) -> float:
+    """1 - jaccard (ref: knn/distance/JaccardDistanceUDF.java: on b-bit minhash
+    signatures, union approximated via k-bit blocks)."""
+    from .similarity import jaccard_similarity
+
+    return 1.0 - jaccard_similarity(a, b, k)
+
+
+# ---- dense batched kernels (TPU path) ----
+
+def euclid_distance_batch(A, B):
+    """Pairwise distances for [N, D] x [M, D] via one matmul."""
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    sq = jnp.sum(A * A, 1)[:, None] + jnp.sum(B * B, 1)[None, :] - 2.0 * A @ B.T
+    return jnp.sqrt(jnp.maximum(sq, 0.0))
+
+
+def cosine_distance_batch(A, B):
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    An = A / jnp.maximum(jnp.linalg.norm(A, axis=1, keepdims=True), 1e-12)
+    Bn = B / jnp.maximum(jnp.linalg.norm(B, axis=1, keepdims=True), 1e-12)
+    return 1.0 - An @ Bn.T

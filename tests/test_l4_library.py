@@ -1,0 +1,296 @@
+"""Function-library tests: ftvec / knn / evaluation / ensemble / tools / dataset
+(ref layer L4, SURVEY.md §2.9-2.15)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from hivemall_tpu import ensemble, evaluation, ftvec, knn, tools
+from hivemall_tpu.dataset import lr_datagen
+from hivemall_tpu.ftvec.trans import Quantifier
+
+
+class TestFtvec:
+    def test_feature_hashing(self):
+        out = ftvec.feature_hashing(["apple:2.0", "orange", "123:1.5"])
+        assert out[2] == "123:1.5"  # int names untouched
+        h, v = out[0].split(":")
+        assert 0 <= int(h) < (1 << 24) and v == "2.0"
+        assert ":" not in out[1]
+
+    def test_rescale(self):
+        assert ftvec.rescale(5.0, 0.0, 10.0) == 0.5
+        assert ftvec.rescale(5.0, 5.0, 5.0) == 0.5
+        assert ftvec.rescale("f:5.0", 0.0, 10.0) == "f:0.5"
+
+    def test_zscore(self):
+        assert ftvec.zscore(12.0, 10.0, 2.0) == 1.0
+        assert ftvec.zscore(12.0, 10.0, 0.0) == 0.0
+
+    def test_l2_normalize(self):
+        out = ftvec.l2_normalize(["a:3", "b:4"])
+        vals = [float(s.split(":")[1]) for s in out]
+        assert vals == pytest.approx([0.6, 0.8])
+
+    def test_amplify(self):
+        assert list(ftvec.amplify(3, ["x", "y"])) == ["x", "x", "x", "y", "y", "y"]
+        with pytest.raises(ValueError):
+            list(ftvec.amplify(0, ["x"]))
+
+    def test_rand_amplify(self):
+        out = list(ftvec.rand_amplify(3, 2, list(range(10)), seed=1))
+        assert len(out) == 30
+        assert sorted(out) == sorted(list(range(10)) * 3)
+        assert out != sorted(out)  # actually shuffled
+
+    def test_powered_features(self):
+        out = ftvec.powered_features(["a:2.0"], 3)
+        assert out == ["a:2.0", "a^2:4.0", "a^3:8.0"]
+        assert ftvec.powered_features(["a:1.0"], 3) == ["a:1.0"]  # truncated
+
+    def test_polynomial_features(self):
+        out = ftvec.polynomial_features(["a:2.0", "b:3.0"], 2)
+        assert "a:2.0" in out and "b:3.0" in out
+        assert "a^b:6.0" in out
+        assert "a^a:4.0" in out
+        out_io = ftvec.polynomial_features(["a:2.0", "b:3.0"], 2, interaction_only=True)
+        assert "a^a:4.0" not in out_io and "a^b:6.0" in out_io
+
+    def test_vectorize_features(self):
+        out = ftvec.vectorize_features(["a", "b", "c"], 1.0, 0.0, 2.5)
+        assert out == ["a", "c:2.5"]
+
+    def test_categorical_quantitative(self):
+        assert ftvec.categorical_features(["c"], "tokyo") == ["c#tokyo"]
+        assert ftvec.quantitative_features(["q"], 1.5) == ["q:1.5"]
+
+    def test_quantify(self):
+        q = Quantifier()
+        assert ftvec.quantify(q, "a", 1.5) == [0.0, 1.5]
+        assert ftvec.quantify(q, "b", 2.0) == [1.0, 2.0]
+        assert ftvec.quantify(q, "a", 9.9) == [0.0, 9.9]
+
+    def test_binarize_label(self):
+        rows = ftvec.binarize_label(2, 1, "f1")
+        assert rows == [("f1", 1), ("f1", 1), ("f1", 0)]
+
+    def test_conv_dense_sparse(self):
+        d = ftvec.to_dense_features(["1:0.5", "3:2.0"], 4)
+        assert d[1] == 0.5 and d[3] == 2.0
+        s = ftvec.to_sparse_features([0.0, 0.5, 0.0, 2.0])
+        assert s == ["1:0.5", "3:2.0"]
+
+    def test_bpr_sampling(self):
+        triples = list(ftvec.bpr_sampling({0: [1, 2], 1: [3]}, max_item_id=9,
+                                          sampling_rate=2.0, seed=3))
+        assert len(triples) > 0
+        for u, i, j in triples:
+            assert j not in ([1, 2] if u == 0 else [3])
+
+    def test_populate_not_in(self):
+        assert list(ftvec.populate_not_in([0, 2], 4)) == [1, 3, 4]
+
+    def test_tf(self):
+        out = ftvec.tf(["a", "b", "a", "a"])
+        assert out["a"] == pytest.approx(0.75)
+
+
+class TestKnn:
+    def test_popcnt_hamming(self):
+        assert knn.popcnt(0b1011) == 3
+        assert knn.hamming_distance(0b1011, 0b0001) == 2
+        assert knn.hamming_distance([1, 2], [1, 3]) == 1  # 2^3 = 0b01 -> one bit
+
+    def test_distances(self):
+        a, b = ["x:1.0", "y:2.0"], ["x:4.0", "y:6.0"]
+        assert knn.euclid_distance(a, b) == pytest.approx(5.0)
+        assert knn.manhattan_distance(a, b) == pytest.approx(7.0)
+        assert knn.minkowski_distance(a, b, 2.0) == pytest.approx(5.0)
+
+    def test_cosine(self):
+        assert knn.cosine_similarity(["x:1"], ["x:1"]) == pytest.approx(1.0)
+        assert knn.cosine_distance(["x:1"], ["y:1"]) == pytest.approx(1.0)
+        assert knn.angular_similarity(["x:1"], ["x:2"]) == pytest.approx(1.0)
+
+    def test_jaccard(self):
+        assert knn.jaccard_similarity(["a", "b"], ["b", "c"]) == pytest.approx(1 / 3)
+        assert knn.jaccard_distance(["a", "b"], ["b", "c"]) == pytest.approx(2 / 3)
+
+    def test_euclid_similarity(self):
+        assert knn.euclid_similarity(["x:1.0"], ["x:1.0"]) == pytest.approx(1.0)
+        assert knn.distance2similarity(1.0) == 0.5
+
+    def test_kld(self):
+        assert knn.kld(0.0, 1.0, 0.0, 1.0) == pytest.approx(0.0)
+
+    def test_minhash_similar_sets_collide(self):
+        f1 = [f"w{i}" for i in range(30)]
+        f2 = f1[:28] + ["zzz", "qqq"]
+        f3 = [f"u{i}" for i in range(30)]
+        c1 = set(knn.minhashes(f1, num_hashes=10))
+        c2 = set(knn.minhashes(f2, num_hashes=10))
+        c3 = set(knn.minhashes(f3, num_hashes=10))
+        assert len(c1 & c2) > len(c1 & c3)
+
+    def test_bbit_minhash(self):
+        s1 = knn.bbit_minhash(["a", "b", "c"], num_hashes=64)
+        s2 = knn.bbit_minhash(["a", "b", "c"], num_hashes=64)
+        assert s1 == s2
+        sim = knn.jaccard_similarity(s1, knn.bbit_minhash(["a", "b", "d"], num_hashes=64),
+                                     k=64)
+        assert 0.0 <= sim <= 1.0
+
+    def test_batch_kernels(self):
+        A = np.eye(3, dtype=np.float32)
+        D = np.asarray(knn.distance.euclid_distance_batch(A, A))
+        assert np.allclose(np.diag(D), 0.0, atol=1e-5)
+        assert D[0, 1] == pytest.approx(math.sqrt(2), rel=1e-5)
+
+
+class TestEvaluation:
+    def test_regression_metrics(self):
+        p, a = [1.0, 2.0, 3.0], [1.5, 2.0, 2.5]
+        assert evaluation.mae(p, a) == pytest.approx(1 / 3)
+        assert evaluation.mse(p, a) == pytest.approx(1 / 6)
+        assert evaluation.rmse(p, a) == pytest.approx(math.sqrt(1 / 6))
+        assert evaluation.r2(a, a) == 1.0
+
+    def test_streaming_matches_oneshot(self):
+        rng = np.random.RandomState(0)
+        p, a = rng.rand(100), rng.rand(100)
+        agg1, agg2 = evaluation.RMSE(), evaluation.RMSE()
+        for x, y in zip(p[:50], a[:50]):
+            agg1.iterate(x, y)
+        for x, y in zip(p[50:], a[50:]):
+            agg2.iterate(x, y)
+        agg1.merge(agg2)  # the PARTIAL2 merge path
+        assert agg1.terminate() == pytest.approx(evaluation.rmse(p, a))
+
+    def test_logloss(self):
+        assert evaluation.logloss([0.9, 0.1], [1, 0]) == pytest.approx(
+            -math.log(0.9), rel=1e-5)
+
+    def test_f1(self):
+        f1 = evaluation.f1score([["a", "b"]], [["a", "c"]])
+        assert f1 == pytest.approx(0.5)
+
+    def test_ndcg(self):
+        assert evaluation.ndcg(["a", "b", "c"], ["a"]) == pytest.approx(1.0)
+        assert evaluation.ndcg(["x", "a"], ["a"]) == pytest.approx(
+            (1 / math.log2(3)) / 1.0)
+
+    def test_auc(self):
+        assert evaluation.auc([0.9, 0.8, 0.3, 0.1], [1, 1, 0, 0]) == 1.0
+        assert evaluation.auc([0.1, 0.9], [1, 0]) == 0.0
+
+    def test_ranking_measures(self):
+        from hivemall_tpu.evaluation import average_precision, hitrate, mrr, precision_at
+        assert precision_at(["a", "x"], ["a"], 2) == 0.5
+        assert mrr(["x", "a"], ["a"]) == 0.5
+        assert hitrate(["x", "a"], ["a"]) == 1.0
+        assert average_precision(["a", "x", "b"], ["a", "b"]) == pytest.approx(
+            (1.0 + 2 / 3) / 2)
+
+
+class TestEnsemble:
+    def test_voted_avg(self):
+        assert ensemble.voted_avg([1.0, 2.0, -1.0]) == 1.5
+        assert ensemble.voted_avg([-1.0, -3.0, 2.0]) == -2.0
+
+    def test_weight_voted_avg(self):
+        assert ensemble.weight_voted_avg([10.0, -1.0, -2.0]) == 10.0
+
+    def test_max_label_maxrow(self):
+        assert ensemble.max_label([(0.2, "a"), (0.9, "b")]) == "b"
+        assert ensemble.maxrow([(1, "x"), (5, "y")]) == (5, "y")
+
+    def test_argmin_kld(self):
+        # precision-weighted: tight covar dominates
+        v = ensemble.argmin_kld([(1.0, 0.01), (3.0, 1.0)])
+        assert v == pytest.approx((1.0 / 0.01 + 3.0) / (1 / 0.01 + 1))
+
+    def test_rf_ensemble(self):
+        label, prob, posteriori = ensemble.rf_ensemble([1, 1, 0])
+        assert label == 1 and prob == pytest.approx(2 / 3)
+        assert posteriori == pytest.approx([1 / 3, 2 / 3])
+
+
+class TestTools:
+    def test_arrays(self):
+        assert tools.float_array(3) == [0.0, 0.0, 0.0]
+        assert tools.array_remove([1, 2, 1], 1) == [2]
+        assert tools.sort_and_uniq_array([3, 1, 3]) == [1, 3]
+        assert tools.subarray([1, 2, 3, 4], 1, 3) == [2, 3]
+        assert tools.subarray_startwith([1, 2, 3], 2) == [2, 3]
+        assert tools.subarray_endwith([1, 2, 3], 2) == [1, 2]
+        assert tools.array_concat([1], [2, 3]) == [1, 2, 3]
+        assert tools.array_avg([[1.0, 2.0], [3.0, 4.0]]) == [2.0, 3.0]
+        assert tools.array_sum([[1.0], [2.0]]) == [3.0]
+        assert tools.array_intersect([1, 2, 3], [2, 3], [3, 2]) == [2, 3]
+        assert tools.to_string_array([1, None]) == ["1", None]
+
+    def test_maps(self):
+        assert tools.map_get_sum({"a": 1.0, "b": 2.0}, ["a", "b", "z"]) == 3.0
+        assert tools.map_tail_n({1: "a", 2: "b", 3: "c"}, 2) == {2: "b", 3: "c"}
+        assert tools.to_map([("k", "v")]) == {"k": "v"}
+        assert list(tools.to_ordered_map([(2, "b"), (1, "a")]).keys()) == [1, 2]
+
+    def test_bits(self):
+        words = tools.to_bits([0, 63, 64])
+        assert tools.unbits(words) == [0, 63, 64]
+        assert tools.unbits(tools.bits_or(tools.to_bits([1]), tools.to_bits([2]))) == [1, 2]
+        assert tools.unbits(tools.bits_collect([5, 1])) == [1, 5]
+
+    def test_compress(self):
+        data = "hello " * 100
+        assert tools.inflate(tools.deflate(data)) == data
+
+    def test_base91_roundtrip(self):
+        for payload in [b"", b"a", b"hello world", bytes(range(256))]:
+            assert tools.unbase91(tools.base91(payload)) == payload
+
+    def test_text(self):
+        assert tools.is_stopword("The".lower()) or tools.is_stopword("the")
+        assert tools.tokenize("Hello, World!") == ["Hello", "World"]
+        assert tools.split_words("a b  c") == ["a", "b", "c"]
+        assert tools.normalize_unicode("ｈｅｌｌｏ") == "hello"
+
+    def test_sigmoid(self):
+        assert tools.sigmoid(0.0) == 0.5
+
+    def test_misc(self):
+        assert tools.generate_series(1, 3) == [1, 2, 3]
+        assert tools.convert_label(-1.0) == 0.0
+        assert tools.convert_label(0.0) == -1.0
+        ranks = list(tools.x_rank(["a", "a", "b"]))
+        assert ranks == [("a", 1), ("a", 2), ("b", 1)]
+
+    def test_each_top_k(self):
+        rows = [("g1", 1.0, "a"), ("g1", 3.0, "b"), ("g1", 2.0, "c"),
+                ("g2", 9.0, "z")]
+        out = list(tools.each_top_k(2, rows))
+        assert out == [(1, 3.0, "b"), (2, 2.0, "c"), (1, 9.0, "z")]
+        bottom = list(tools.each_top_k(-1, rows[:3]))
+        assert bottom == [(1, 1.0, "a")]
+
+    def test_mapred(self):
+        assert tools.rowid() != tools.rowid()
+        assert isinstance(tools.jobid(), str)
+
+
+class TestDataset:
+    def test_lr_datagen_sparse(self):
+        rows, labels = lr_datagen("-n_examples 100 -n_features 5 -n_dims 50 -cl")
+        assert len(rows) == 100 and len(labels) == 100
+        assert set(np.unique(labels)) <= {0.0, 1.0}
+        assert all(len(r) == 5 for r in rows)
+
+    def test_lr_datagen_dense_trainable(self):
+        from hivemall_tpu.models.classifier import train_arow
+
+        rows, labels = lr_datagen("-n_examples 400 -n_features 10 -n_dims 30 -cl -seed 7")
+        y = np.where(labels > 0, 1, -1)
+        model = train_arow(rows, y, "-dims 30")
+        acc = np.mean(np.sign(model.predict(rows)) == y)
+        assert acc > 0.8, acc
