@@ -1,0 +1,67 @@
+"""Benchmark: AROW online-classifier training throughput on the full-size
+2^22-dim hashed model (the reference's headline workload shape — KDD2012
+Track 2 CTR-style sparse rows trained by train_arow, BASELINE.json).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline anchor: the reference trains per-row on a JVM; a single Hive mapper
+sustains on the order of 2.5e5 AROW updates/sec (measured JVM hot-loop scale
+for hash + gather + covariance update per row; the repo itself publishes no
+numbers — BASELINE.md). vs_baseline = our rows/sec over that anchor.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_ROWS_PER_SEC = 250_000.0
+
+
+def main() -> None:
+    import jax
+
+    from hivemall_tpu.core.engine import make_train_step
+    from hivemall_tpu.core.state import init_linear_state
+    from hivemall_tpu.models.classifier import AROW
+
+    platform = jax.devices()[0].platform
+    dims = 1 << 22
+    batch = 16384
+    width = 32  # nnz per row, KDD CTR-ish
+    n_blocks = 8
+
+    rng = np.random.RandomState(0)
+    # zipf-ish skewed feature ids like hashed CTR data
+    idx = (rng.zipf(1.3, size=(n_blocks, batch, width)) % dims).astype(np.int32)
+    val = np.ones((n_blocks, batch, width), dtype=np.float32)
+    lab = np.sign(rng.randn(n_blocks, batch)).astype(np.float32)
+
+    step = make_train_step(AROW, {"r": 0.1}, mode="minibatch", donate=True)
+    state = init_linear_state(dims, use_covariance=True)
+
+    # warmup / compile
+    state, loss = step(state, idx[0], val[0], lab[0])
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    rounds = 5
+    total_rows = 0
+    for r in range(rounds):
+        for b in range(n_blocks):
+            state, loss = step(state, idx[b], val[b], lab[b])
+            total_rows += batch
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    rows_per_sec = total_rows / dt
+    print(json.dumps({
+        "metric": f"arow_train_throughput_2^22dims_{width}nnz_{platform}",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(rows_per_sec / BASELINE_ROWS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
