@@ -1,0 +1,46 @@
+"""`tree_predict` + `guess_attrs` (ref: smile/tools/TreePredictUDF.java:143-326,
+smile/tools/GuessAttributesUDF.java)."""
+
+from __future__ import annotations
+
+import json
+from typing import List, Sequence, Union
+
+import numpy as np
+
+from .export import eval_json_tree
+from .vm import StackMachine
+
+
+def tree_predict(model_type: str, model: str, features: Sequence[float],
+                 classification: bool = True) -> Union[int, float]:
+    """Evaluate an exported tree on one raw feature vector. Evaluators:
+    opscode -> StackMachine (ref: TreePredictUDF.java:257), json -> node-graph
+    walk (the serialization-evaluator analog, :205), javascript unsupported
+    off-JVM (Rhino, :326) — export json/opscode instead."""
+    mt = model_type.lower()
+    if mt in ("opscode", "vm"):
+        result = StackMachine().run(model, features)
+        if result is None:
+            raise ValueError("opscode evaluation returned no result")
+        return int(result) if classification else float(result)
+    if mt in ("json", "serialization", "ser"):
+        out = eval_json_tree(model, list(features))
+        return int(out) if classification else float(out)
+    raise ValueError(f"unsupported model type: {model_type}")
+
+
+def guess_attrs(row: Sequence) -> str:
+    """Guess Q/C attribute types from a sample row — strings/bools are
+    categorical, numbers quantitative (ref: GuessAttributesUDF.java)."""
+    attrs: List[str] = []
+    for v in row:
+        if isinstance(v, bool) or isinstance(v, str):
+            attrs.append("C")
+        elif isinstance(v, (int, np.integer)):
+            # integers could be either; the reference guesses from the Hive
+            # column type — int columns are quantitative
+            attrs.append("Q")
+        else:
+            attrs.append("Q")
+    return ",".join(attrs)

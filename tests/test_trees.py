@@ -1,0 +1,157 @@
+"""Decision forest tests (model: smile/classification/DecisionTreeTest,
+RandomForestClassifierUDTF tests, StackMachineTest — SURVEY.md §2.8/§4)."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models import trees as T
+from hivemall_tpu.models.trees.binning import bin_data, make_bins
+from hivemall_tpu.models.trees.export import to_json, to_opscode
+from hivemall_tpu.models.trees.grow import grow_tree, predict_binned
+
+
+def _gen_classification(n=600, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 6)
+    # axis-aligned ground truth with an interaction
+    y = ((X[:, 0] > 0.5) & (X[:, 2] < 0.7)).astype(int)
+    return X, y
+
+
+def _gen_xor(n=800, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4)
+    y = ((X[:, 0] > 0.5) ^ (X[:, 1] > 0.5)).astype(int)
+    return X, y
+
+
+class TestGrow:
+    def test_single_tree_fits_axis_aligned(self):
+        X, y = _gen_classification()
+        bins = make_bins(X, ["Q"] * 6)
+        Xb = bin_data(X, bins)
+        tree = grow_tree(Xb, y, np.ones(len(y), np.float32),
+                         np.zeros(6, bool), max(b.n_bins for b in bins),
+                         classification=True, n_classes=2, max_depth=6)
+        leaf = predict_binned(tree, Xb)
+        pred = tree.leaf_value[leaf].astype(int)
+        assert np.mean(pred == y) > 0.97
+
+    def test_regression_tree(self):
+        rng = np.random.RandomState(0)
+        X = rng.rand(500, 3)
+        y = np.where(X[:, 1] > 0.5, 2.0, -1.0).astype(np.float32)
+        bins = make_bins(X, ["Q"] * 3)
+        Xb = bin_data(X, bins)
+        tree = grow_tree(Xb, y, np.ones(500, np.float32), np.zeros(3, bool),
+                         max(b.n_bins for b in bins), classification=False,
+                         max_depth=4)
+        leaf = predict_binned(tree, Xb)
+        assert np.mean(np.abs(tree.leaf_value[leaf] - y)) < 0.1
+
+    def test_nominal_split(self):
+        rng = np.random.RandomState(0)
+        cat = rng.randint(0, 4, size=400)
+        X = np.stack([cat.astype(float), rng.rand(400)], axis=1)
+        y = (cat == 2).astype(int)
+        bins = make_bins(X, ["C", "Q"])
+        Xb = bin_data(X, bins)
+        tree = grow_tree(Xb, y, np.ones(400, np.float32),
+                         np.array([True, False]), max(b.n_bins for b in bins),
+                         classification=True, n_classes=2, max_depth=4)
+        leaf = predict_binned(tree, Xb)
+        pred = tree.leaf_value[leaf].astype(int)
+        assert np.mean(pred == y) > 0.99
+
+
+class TestForest:
+    def test_rf_classifier_xor(self):
+        X, y = _gen_xor()
+        forest = T.train_randomforest_classifier(X, y, "-trees 20 -seed 42")
+        acc = np.mean(forest.predict(X) == y)
+        assert acc > 0.95, acc
+
+    def test_rf_model_rows_schema(self):
+        X, y = _gen_classification(n=200)
+        forest = T.train_randomforest_classifier(X, y, "-trees 3 -seed 1")
+        rows = forest.model_rows()
+        assert len(rows) == 3
+        mid, mtype, model, importance, oob_err, oob_tests = rows[0]
+        assert mtype == "opscode" and len(importance) == 6
+        assert oob_tests > 0 and 0 <= oob_err <= oob_tests
+
+    def test_rf_oob_error_reasonable(self):
+        X, y = _gen_classification()
+        forest = T.train_randomforest_classifier(X, y, "-trees 10 -seed 3")
+        err = sum(t.oob_errors for t in forest.trees)
+        tests = sum(t.oob_tests for t in forest.trees)
+        assert err / tests < 0.1
+
+    def test_rf_regressor(self):
+        rng = np.random.RandomState(2)
+        X = rng.rand(500, 4)
+        y = 3.0 * X[:, 0] + np.sin(4 * X[:, 1])
+        forest = T.train_randomforest_regr(X, y, "-trees 20 -seed 5")
+        rmse = np.sqrt(np.mean((forest.predict(X) - y) ** 2))
+        assert rmse < 0.35, rmse
+
+    def test_rf_entropy_rule(self):
+        X, y = _gen_classification(n=300)
+        forest = T.train_randomforest_classifier(X, y, "-trees 5 -rule ENTROPY -seed 9")
+        assert np.mean(forest.predict(X) == y) > 0.9
+
+
+class TestExportAndVM:
+    def test_opscode_matches_direct_predict(self):
+        X, y = _gen_classification(n=300)
+        forest = T.train_randomforest_classifier(X, y, "-trees 3 -seed 7")
+        t = forest.trees[0]
+        Xb = bin_data(X, forest.bins)
+        leafs = predict_binned(t.tree, Xb)
+        direct = t.tree.leaf_value[leafs].astype(int)
+        for i in range(0, 50):
+            via_vm = T.tree_predict("opscode", t.model, X[i])
+            assert via_vm == direct[i], i
+
+    def test_json_export_matches(self):
+        X, y = _gen_classification(n=200)
+        forest = T.train_randomforest_classifier(X, y, "-trees 2 -seed 8 -output ser")
+        t = forest.trees[0]
+        Xb = bin_data(X, forest.bins)
+        direct = t.tree.leaf_value[predict_binned(t.tree, Xb)].astype(int)
+        for i in range(0, 40):
+            assert T.tree_predict("json", t.model, X[i]) == direct[i]
+
+    def test_stack_machine_basics(self):
+        # hand-written script: x[0] <= 0.5 -> 0 else 1 (the reference VM
+        # grammar: true branch falls through, ifle jumps to false branch)
+        script = "push x[0]; push 0.5; ifle 5; push 0; goto last; push 1; goto last; call end"
+        vm = T.StackMachine()
+        assert vm.run(script, [0.3]) == 0.0  # true branch falls through
+        assert vm.run(script, [0.9]) == 1.0  # ifle jumps to the false branch
+
+    def test_stack_machine_infinite_loop_detection(self):
+        vm = T.StackMachine()
+        with pytest.raises(Exception):
+            vm.run("goto 0", [0.0])
+
+    def test_guess_attrs(self):
+        assert T.guess_attrs([1.5, "tokyo", 3]) == "Q,C,Q"
+
+
+class TestGBT:
+    def test_gbt_binary(self):
+        X, y = _gen_xor(n=500)
+        gbt = T.train_gradient_tree_boosting_classifier(
+            X, y, "-trees 30 -eta 0.2 -depth 4 -seed 11")
+        acc = np.mean(gbt.predict(X) == y)
+        assert acc > 0.95, acc
+
+    def test_gbt_multiclass(self):
+        rng = np.random.RandomState(0)
+        X = rng.rand(600, 4)
+        y = (X[:, 0] * 3).astype(int)  # 3 classes by threshold
+        gbt = T.train_gradient_tree_boosting_classifier(
+            X, y, "-trees 20 -eta 0.2 -depth 3 -seed 12")
+        acc = np.mean(gbt.predict(X) == y)
+        assert acc > 0.93, acc
