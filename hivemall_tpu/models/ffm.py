@@ -154,7 +154,7 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan"):
         if hyper.use_adagrad:
             eta_v = hyper.eta0_v / jnp.sqrt(hyper.eps + gg)
         else:
-            eta_v = hyper.eta.eta(t)
+            eta_v = jnp.broadcast_to(hyper.eta.eta(t), gg.shape)
         Vcur = Vg
         dV = -eta_v[:, :, None] * (gradV + 2.0 * hyper.lambda_v * Vcur)
         # zero out padded lanes (val == 0 kills coeff already; L2 pull must
