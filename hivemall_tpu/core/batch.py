@@ -67,6 +67,14 @@ def pack_rows(
     if width is None:
         width = pad_to_bucket(max_nnz)
     b = batch_size if batch_size is not None else n
+    if b == n and n > 0:
+        from .. import native
+
+        packed = native.pack_block(idx_rows, val_rows, width, dims)
+        if packed is not None:
+            out_idx, out_val, out_nnz = packed
+            return FeatureBlock(out_idx, out_val,
+                                np.asarray(labels, dtype=np.float32), out_nnz)
     indices = np.full((b, width), dims, dtype=np.int32)
     values = np.zeros((b, width), dtype=np.float32)
     labs = np.zeros((b,), dtype=np.float32)

@@ -1,0 +1,98 @@
+"""ctypes bindings for the native host ops (native/hivemall_native.cpp).
+
+The C++ library accelerates the host-side input pipeline: bulk murmur3 feature
+hashing and padded-CSR block packing (the [native-equiv] substrate pieces from
+SURVEY.md §2.17). Python/numpy fallbacks are used automatically when the .so
+hasn't been built (scripts/build_native.sh)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libhivemall_native.so")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.hm_murmur3_x86_32.restype = ctypes.c_int32
+    lib.hm_murmur3_x86_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                      ctypes.c_uint32]
+    lib.hm_murmur3_bulk.restype = None
+    lib.hm_murmur3_bulk.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.hm_pack_block.restype = None
+    lib.hm_pack_block.argtypes = [ctypes.c_void_p] * 3 + [
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def murmur3(data: bytes, seed: int = 0x9747B28C) -> Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    return int(lib.hm_murmur3_x86_32(data, len(data), seed))
+
+
+def murmur3_bulk(strings: Sequence[bytes], num_features: int,
+                 seed: int = 0x9747B28C) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(strings)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, s in enumerate(strings):
+        offsets[i + 1] = offsets[i] + len(s)
+    buf = b"".join(strings)
+    out = np.empty(n, dtype=np.int64)
+    cbuf = ctypes.create_string_buffer(buf, len(buf) or 1)
+    lib.hm_murmur3_bulk(
+        ctypes.cast(cbuf, ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p), n, seed, num_features,
+        out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def pack_block(idx_rows: Sequence[np.ndarray], val_rows: Sequence[np.ndarray],
+               width: int, dims: int
+               ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(idx_rows)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, r in enumerate(idx_rows):
+        offsets[i + 1] = offsets[i] + len(r)
+    indices = (np.concatenate(idx_rows).astype(np.int64) if n else
+               np.zeros(0, np.int64))
+    values = (np.concatenate(val_rows).astype(np.float32) if n else
+              np.zeros(0, np.float32))
+    out_idx = np.empty((n, width), dtype=np.int32)
+    out_val = np.empty((n, width), dtype=np.float32)
+    out_nnz = np.empty(n, dtype=np.int32)
+    lib.hm_pack_block(
+        indices.ctypes.data_as(ctypes.c_void_p),
+        values.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p), n, width, dims,
+        out_idx.ctypes.data_as(ctypes.c_void_p),
+        out_val.ctypes.data_as(ctypes.c_void_p),
+        out_nnz.ctypes.data_as(ctypes.c_void_p))
+    return out_idx, out_val, out_nnz

@@ -90,6 +90,12 @@ def murmurhash3_bytes_batch(
     bss: List[bytes] = [s.encode("utf-8") if isinstance(s, str) else s for s in strings]
     if not bss:
         return np.zeros((0,), dtype=np.int64)
+    if seed == DEFAULT_SEED:
+        from .. import native
+
+        out = native.murmur3_bulk(bss, num_features)
+        if out is not None:
+            return out
     lens = np.array([len(b) for b in bss], dtype=np.int64)
     maxlen = int(lens.max())
     padded = int(-(-max(maxlen, 1) // 4) * 4)

@@ -1,0 +1,142 @@
+// hivemall-tpu native host ops.
+//
+// The reference's performance-critical host-side pieces are hand-written Java
+// data structures (SURVEY.md §2.17 [native-equiv]): MurmurHash3
+// (utils/hashing/MurmurHash3.java:26-144), the feature parsers, and the NIO
+// staging buffers. Here they are C++: bulk feature hashing and padded-CSR
+// block packing feed the TPU input pipeline without Python-loop overhead.
+//
+// Exposed as a plain C ABI consumed via ctypes (hivemall_tpu/native/__init__.py).
+// Build: scripts/build_native.sh (cmake or direct g++).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+extern "C" {
+
+// ---------------------------------------------------------------- murmur3
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+    h ^= h >> 16;
+    h *= 0x85ebca6bU;
+    h ^= h >> 13;
+    h *= 0xc2b2ae35U;
+    h ^= h >> 16;
+    return h;
+}
+
+// MurmurHash3_x86_32 over a byte buffer; returns the SIGNED 32-bit value the
+// JVM reference returns (bit-identical; seed 0x9747b28c is the reference's).
+int32_t hm_murmur3_x86_32(const uint8_t* data, int64_t len, uint32_t seed) {
+    const int64_t nblocks = len / 4;
+    uint32_t h1 = seed;
+    const uint32_t c1 = 0xcc9e2d51U;
+    const uint32_t c2 = 0x1b873593U;
+
+    const uint32_t* blocks = reinterpret_cast<const uint32_t*>(data);
+    for (int64_t i = 0; i < nblocks; i++) {
+        uint32_t k1;
+        std::memcpy(&k1, blocks + i, 4);  // little-endian load
+        k1 *= c1;
+        k1 = rotl32(k1, 15);
+        k1 *= c2;
+        h1 ^= k1;
+        h1 = rotl32(h1, 13);
+        h1 = h1 * 5 + 0xe6546b64U;
+    }
+
+    const uint8_t* tail = data + nblocks * 4;
+    uint32_t k1 = 0;
+    switch (len & 3) {
+        case 3: k1 ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+        case 2: k1 ^= static_cast<uint32_t>(tail[1]) << 8; [[fallthrough]];
+        case 1:
+            k1 ^= tail[0];
+            k1 *= c1;
+            k1 = rotl32(k1, 15);
+            k1 *= c2;
+            h1 ^= k1;
+    }
+
+    h1 ^= static_cast<uint32_t>(len);
+    return static_cast<int32_t>(fmix32(h1));
+}
+
+// Bulk hash: `n` strings concatenated in `buf` with offsets[n+1]; results
+// folded into [0, num_features) with Java floor-mod semantics
+// (ref: MurmurHash3.java:40-46).
+void hm_murmur3_bulk(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                     uint32_t seed, int64_t num_features, int64_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        const int64_t start = offsets[i];
+        const int64_t len = offsets[i + 1] - start;
+        int64_t h = hm_murmur3_x86_32(buf + start, len, seed);
+        int64_t r = h % num_features;
+        if (r < 0) r += num_features;
+        out[i] = r;
+    }
+}
+
+// ---------------------------------------------------------------- CSR pack
+
+// Pack variable-length hashed rows into a padded [n_rows, width] block
+// (core/batch.py layout: pad index == dims -> OOB drop, pad value == 0).
+// rows are concatenated in `indices`/`values` with `offsets[n_rows+1]`.
+void hm_pack_block(const int64_t* indices, const float* values,
+                   const int64_t* offsets, int64_t n_rows, int64_t width,
+                   int64_t dims, int32_t* out_idx, float* out_val,
+                   int32_t* out_nnz) {
+    for (int64_t r = 0; r < n_rows; r++) {
+        const int64_t start = offsets[r];
+        int64_t k = offsets[r + 1] - start;
+        if (k > width) k = width;
+        int32_t* oi = out_idx + r * width;
+        float* ov = out_val + r * width;
+        int64_t c = 0;
+        for (; c < k; c++) {
+            oi[c] = static_cast<int32_t>(indices[start + c] % dims);
+            ov[c] = values[start + c];
+        }
+        for (; c < width; c++) {
+            oi[c] = static_cast<int32_t>(dims);
+            ov[c] = 0.0f;
+        }
+        out_nnz[r] = static_cast<int32_t>(k);
+    }
+}
+
+// Parse a "idx:value" / "idx" feature byte-string (int features) without
+// Python per-token overhead. Returns 0 on success.
+int32_t hm_parse_int_feature(const uint8_t* s, int64_t len, int64_t* out_idx,
+                             float* out_val) {
+    int64_t i = 0;
+    int64_t idx = 0;
+    bool any = false;
+    for (; i < len && s[i] != ':'; i++) {
+        if (s[i] < '0' || s[i] > '9') return -1;
+        idx = idx * 10 + (s[i] - '0');
+        any = true;
+    }
+    if (!any) return -1;
+    *out_idx = idx;
+    if (i == len) {
+        *out_val = 1.0f;
+        return 0;
+    }
+    // value part
+    char tmp[64];
+    int64_t vlen = len - i - 1;
+    if (vlen <= 0 || vlen >= 63) return -1;
+    std::memcpy(tmp, s + i + 1, vlen);
+    tmp[vlen] = '\0';
+    char* end = nullptr;
+    *out_val = std::strtof(tmp, &end);
+    return (end && *end == '\0') ? 0 : -1;
+}
+
+}  // extern "C"

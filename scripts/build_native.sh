@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Build the native host-ops shared library (native/hivemall_native.cpp) into
+# hivemall_tpu/native/libhivemall_native.so. Pure C ABI, consumed via ctypes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p hivemall_tpu/native
+g++ -O3 -march=native -fPIC -shared -std=c++17 \
+    native/hivemall_native.cpp \
+    -o hivemall_tpu/native/libhivemall_native.so
+echo "built hivemall_tpu/native/libhivemall_native.so"

@@ -1,0 +1,44 @@
+"""Native C++ host-op tests: bit parity with the Python/numpy paths."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu import native
+from hivemall_tpu.core.batch import pack_rows
+from hivemall_tpu.utils.hashing import mhash, murmurhash3_x86_32
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library not built")
+
+
+def test_murmur3_scalar_parity():
+    for s in ["", "a", "hello world", "feature:123", "日本語", "x" * 999]:
+        b = s.encode("utf-8")
+        assert native.murmur3(b) == murmurhash3_x86_32(s)
+
+
+def test_murmur3_bulk_parity():
+    rng = np.random.RandomState(0)
+    strs = [bytes(rng.randint(0, 256, size=rng.randint(0, 64)).astype(np.uint8))
+            for _ in range(500)]
+    out = native.murmur3_bulk(strs, 1 << 24)
+    expected = np.array([murmurhash3_x86_32(b) % (1 << 24) for b in strs])
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_pack_block_parity():
+    rng = np.random.RandomState(1)
+    idx_rows = [rng.randint(0, 1000, size=rng.randint(1, 9)).astype(np.int64)
+                for _ in range(64)]
+    val_rows = [rng.rand(len(r)).astype(np.float32) for r in idx_rows]
+    labels = rng.randn(64).astype(np.float32)
+    blk = pack_rows(idx_rows, val_rows, labels, dims=1024, width=8)  # native path
+    out = native.pack_block(idx_rows, val_rows, 8, 1024)
+    assert out is not None
+    np.testing.assert_array_equal(blk.indices, out[0])
+    np.testing.assert_array_equal(blk.values, out[1])
+    for i, r in enumerate(idx_rows):
+        k = len(r)
+        np.testing.assert_array_equal(blk.indices[i, :k], r % 1024)
+        assert np.all(blk.indices[i, k:] == 1024)
+        assert np.all(blk.values[i, k:] == 0.0)
