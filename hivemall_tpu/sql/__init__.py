@@ -1,0 +1,1 @@
+from .registry import REGISTRY, get_function, list_functions, macros  # noqa: F401
