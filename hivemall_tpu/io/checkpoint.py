@@ -1,0 +1,96 @@
+"""Checkpoint / warm start.
+
+The reference's persistence model is model-as-table: trainers dump
+(feature, weight[, covar]) rows at close(), and warm start reloads such a
+table via `-loadmodel <file>` from the Hive distributed cache
+(ref: LearnerBaseUDTF.java:215-333; SURVEY.md §5 "Checkpoint / resume").
+
+Two tiers here:
+- `save_model_rows` / `load_model_rows` — the interchange format: a
+  key-value table (npz), optionally compressed with the sparse codec
+  (utils/codec.encode_sparse_model — the FFM/tree blob recipe).
+- `save_linear_state` / `load_linear_state` — full training-state checkpoint
+  (all slots + step counter), enabling MID-TRAINING resume, which the
+  reference cannot do (its replay files are deleteOnExit temp files,
+  FactorizationMachineUDTF.java:301-302).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core.state import LinearState, init_linear_state
+from ..utils.codec import decode_sparse_model, encode_sparse_model
+
+
+def save_model_rows(path: str, feats: np.ndarray, weights: np.ndarray,
+                    covars: Optional[np.ndarray] = None,
+                    compressed: bool = False) -> None:
+    if compressed:
+        with open(path, "wb") as f:
+            f.write(encode_sparse_model(feats, weights))
+        return
+    data = {"feature": np.asarray(feats), "weight": np.asarray(weights)}
+    if covars is not None:
+        data["covar"] = np.asarray(covars)
+    np.savez_compressed(path, **data)
+
+
+def load_model_rows(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+    if path.endswith(".npz"):
+        z = np.load(path)
+        return z["feature"], z["weight"], z["covar"] if "covar" in z.files else None
+    with open(path, "rb") as f:
+        feats, weights = decode_sparse_model(f.read())
+    return feats, weights, None
+
+
+def dense_from_rows(dims: int, feats: np.ndarray, weights: np.ndarray,
+                    covars: Optional[np.ndarray] = None):
+    """Model rows -> dense warm-start arrays (the loadPredictionModel path)."""
+    w = np.zeros(dims, np.float32)
+    w[np.asarray(feats, np.int64) % dims] = weights
+    c = None
+    if covars is not None:
+        c = np.ones(dims, np.float32)
+        c[np.asarray(feats, np.int64) % dims] = covars
+    return w, c
+
+
+def save_linear_state(path: str, state: LinearState) -> None:
+    host = jax.device_get(state)
+    arrays = {
+        "weights": np.asarray(host.weights),
+        "touched": np.asarray(host.touched),
+        "step": np.asarray(host.step),
+    }
+    if host.covars is not None:
+        arrays["covars"] = np.asarray(host.covars)
+    for k, v in host.slots.items():
+        arrays[f"slot__{k}"] = np.asarray(v)
+    for k, v in host.globals.items():
+        arrays[f"global__{k}"] = np.asarray(v)
+    np.savez_compressed(path, **arrays)
+
+
+def load_linear_state(path: str) -> LinearState:
+    z = np.load(path)
+    import jax.numpy as jnp
+
+    slots = {k[len("slot__"):]: jnp.asarray(z[k]) for k in z.files
+             if k.startswith("slot__")}
+    globals_ = {k[len("global__"):]: jnp.asarray(z[k]) for k in z.files
+                if k.startswith("global__")}
+    return LinearState(
+        weights=jnp.asarray(z["weights"]),
+        covars=jnp.asarray(z["covars"]) if "covars" in z.files else None,
+        slots=slots,
+        touched=jnp.asarray(z["touched"]),
+        step=jnp.asarray(z["step"]),
+        globals=globals_,
+    )

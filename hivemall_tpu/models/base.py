@@ -40,6 +40,8 @@ def base_options() -> Options:
     o.add("dims", "feature_dimensions", True,
           "The dimension of model [default: 2^24 hashed space]", default=None, type=int)
     o.add("disable_halffloat", None, False, "(accepted for parity; TPU uses fp32/bf16)")
+    o.add("loadmodel", None, True,
+          "Warm-start from a saved model-rows table (ref: LearnerBaseUDTF.java:215-333)")
     o.add("mini_batch", "mini_batch_size", True,
           "Mini batch size [default: 1 = exact per-row scan]", default=1, type=int)
     o.add("iters", "iterations", True, "Number of epochs [default: 1]", default=1, type=int)
@@ -118,6 +120,12 @@ def fit_linear(
     labels = np.asarray(labels, dtype=np.float32)
     if label_map is not None:
         labels = label_map(labels)
+
+    if cl.has("loadmodel") and initial_weights is None:
+        from ..io.checkpoint import dense_from_rows, load_model_rows
+
+        feats0, w0, c0 = load_model_rows(cl.get("loadmodel"))
+        initial_weights, initial_covars = dense_from_rows(dims, feats0, w0, c0)
 
     idx_rows, val_rows = _stage_rows(features, dims)
     n = len(idx_rows)

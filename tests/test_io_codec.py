@@ -1,0 +1,95 @@
+"""Codec + checkpoint/warm-start tests (ref: utils/codec/*, LearnerBaseUDTF
+-loadmodel, SURVEY.md §5 checkpoint/resume)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.io import (load_linear_state, load_model_rows,
+                             save_linear_state, save_model_rows)
+from hivemall_tpu.models.classifier import train_arow, train_perceptron
+from hivemall_tpu.utils import codec
+
+
+class TestCodecs:
+    def test_half_float_roundtrip(self):
+        xs = np.array([0.0, 1.0, -2.5, 65504.0, 1e-4], np.float32)
+        back = codec.half_to_float(codec.float_to_half(xs))
+        np.testing.assert_allclose(back, xs, rtol=1e-3)
+        assert codec.bits_to_half_float(codec.half_float_bits(1.0)) == 1.0
+
+    def test_zigzag(self):
+        for v in [0, 1, -1, 123456, -123456]:
+            assert codec.zigzag_decode(codec.zigzag_encode(v)) == v
+
+    def test_leb128(self):
+        buf = bytearray()
+        codec.leb128_encode(300, buf)
+        v, pos = codec.leb128_decode(bytes(buf))
+        assert v == 300 and pos == len(buf)
+
+    def test_zigzag_leb128_array(self):
+        vals = [0, -5, 1000, -123456, 7]
+        enc = codec.zigzag_leb128_encode_array(vals)
+        assert codec.zigzag_leb128_decode_array(enc, len(vals)) == vals
+
+    def test_vbyte(self):
+        vals = [0, 127, 128, 1 << 20]
+        assert codec.vbyte_decode(codec.vbyte_encode(vals), len(vals)) == vals
+
+    def test_sparse_model_blob(self):
+        feats = np.array([5, 100, 7, 1 << 22])
+        weights = np.array([0.5, -1.25, 3.0, 0.125], np.float32)
+        blob = codec.encode_sparse_model(feats, weights)
+        f2, w2 = codec.decode_sparse_model(blob)
+        order = np.argsort(feats)
+        np.testing.assert_array_equal(f2, feats[order])
+        np.testing.assert_allclose(w2, weights[order], rtol=1e-3)
+
+
+class TestCheckpoint:
+    def _small_model(self):
+        rows = ([np.array([0, 1]), np.array([2])],
+                [np.array([1.0, 2.0]), np.array([1.0])])
+        return train_arow(rows, [1, -1], "-dims 16")
+
+    def test_model_rows_roundtrip(self, tmp_path):
+        m = self._small_model()
+        f, w, c = m.model_rows()
+        p = str(tmp_path / "model.npz")
+        save_model_rows(p, f, w, c)
+        f2, w2, c2 = load_model_rows(p)
+        np.testing.assert_array_equal(f, f2)
+        np.testing.assert_allclose(w, w2)
+        np.testing.assert_allclose(c, c2)
+
+    def test_compressed_model_rows(self, tmp_path):
+        m = self._small_model()
+        f, w, _ = m.model_rows()
+        p = str(tmp_path / "model.bin")
+        save_model_rows(p, f, w, compressed=True)
+        f2, w2, _ = load_model_rows(p)
+        np.testing.assert_array_equal(np.sort(f), f2)
+
+    def test_warm_start_loadmodel(self, tmp_path):
+        m = self._small_model()
+        f, w, c = m.model_rows()
+        p = str(tmp_path / "warm.npz")
+        save_model_rows(p, f, w, c)
+        # warm-started model without further updates == saved weights
+        rows = ([np.array([5])], [np.array([0.0])])  # zero-value row: no update
+        m2 = train_arow(rows, [1], f"-dims 16 -loadmodel {p}")
+        w_dense = np.zeros(16, np.float32)
+        w_dense[f] = w
+        got = np.asarray(m2.state.weights)
+        np.testing.assert_allclose(got, w_dense, rtol=1e-6)
+
+    def test_full_state_resume(self, tmp_path):
+        m = self._small_model()
+        p = str(tmp_path / "state.npz")
+        save_linear_state(p, m.state)
+        st = load_linear_state(p)
+        np.testing.assert_allclose(np.asarray(st.weights), np.asarray(m.state.weights))
+        np.testing.assert_allclose(np.asarray(st.covars), np.asarray(m.state.covars))
+        assert int(st.step) == int(m.state.step)
