@@ -1,0 +1,1 @@
+from .dataframe import HivemallFrame, hivemall_ops  # noqa: F401

@@ -1,0 +1,143 @@
+"""DataFrame DSL — the Spark-module analog.
+
+The reference's spark module wraps every trainer as an implicit DataFrame
+method (`df.train_arow('features, 'label)` etc.,
+ref: spark/src/main/scala/org/apache/spark/sql/hive/HivemallOps.scala:67-475)
+plus grouped aggregates (GroupedDataEx.scala:134-257). The pandas-facing
+equivalent here wraps the same registry:
+
+    hf = hivemall_ops(df)                       # df: pandas DataFrame
+    model = hf.train_arow("features", "label", "-dims 1024")
+    df2 = hf.amplify(3)
+    agg = hf.groupby("feature").argmin_kld("weight", "covar")
+
+Streaming predict (HivemallStreamingOps.scala:27-46) maps to
+`predict_stream(model, batches)` over an iterator of DataFrames.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from ..ensemble import argmin_kld as _argmin_kld
+from ..ensemble import max_label as _max_label
+from ..ensemble import voted_avg as _voted_avg
+from ..ensemble import weight_voted_avg as _weight_voted_avg
+from ..sql import get_function
+
+
+class _GroupedOps:
+    def __init__(self, df, by):
+        self._df = df
+        self._by = by
+
+    def _agg(self, fn: Callable, *cols: str, name: str = "value"):
+        import pandas as pd
+
+        rows = []
+        for key, grp in self._df.groupby(self._by):
+            if len(cols) == 1:
+                out = fn(grp[cols[0]].tolist())
+            else:
+                out = fn(list(zip(*(grp[c].tolist() for c in cols))))
+            rows.append((key, out))
+        return pd.DataFrame(rows, columns=[self._by, name])
+
+    def voted_avg(self, col: str):
+        return self._agg(_voted_avg, col)
+
+    def weight_voted_avg(self, col: str):
+        return self._agg(_weight_voted_avg, col)
+
+    def argmin_kld(self, mean_col: str, covar_col: str):
+        return self._agg(_argmin_kld, mean_col, covar_col)
+
+    def max_label(self, score_col: str, label_col: str):
+        return self._agg(_max_label, score_col, label_col)
+
+    def rf_ensemble(self, col: str):
+        from ..ensemble import rf_ensemble
+
+        return self._agg(rf_ensemble, col)
+
+    def mae(self, pred_col: str, actual_col: str):
+        from ..evaluation import mae
+
+        import pandas as pd
+
+        rows = [(k, mae(g[pred_col], g[actual_col]))
+                for k, g in self._df.groupby(self._by)]
+        return pd.DataFrame(rows, columns=[self._by, "mae"])
+
+
+class HivemallFrame:
+    """Thin wrapper exposing registry functions as DataFrame methods."""
+
+    def __init__(self, df):
+        self._df = df
+
+    @property
+    def df(self):
+        return self._df
+
+    def groupby(self, by: str) -> _GroupedOps:
+        return _GroupedOps(self._df, by)
+
+    # ---- trainers: df.train_xxx(features_col, label_col, options) ----
+    def __getattr__(self, name: str):
+        if name.startswith("train_"):
+            fn = get_function(name)
+
+            def trainer(features_col: str, label_col: str,
+                        options: Optional[str] = None, **kw):
+                feats = self._df[features_col].tolist()
+                labels = self._df[label_col].to_numpy()
+                return fn(feats, labels, options, **kw)
+
+            return trainer
+        raise AttributeError(name)
+
+    # ---- row transforms mirroring HivemallOps:521-673 ----
+    def amplify(self, xtimes: int) -> "HivemallFrame":
+        import pandas as pd
+
+        idx = np.repeat(np.arange(len(self._df)), xtimes)
+        return HivemallFrame(self._df.iloc[idx].reset_index(drop=True))
+
+    def rand_amplify(self, xtimes: int, num_buffers: int = 2,
+                     seed: int = 31) -> "HivemallFrame":
+        from ..ftvec import rand_amplify as ra
+
+        import pandas as pd
+
+        rows = list(ra(xtimes, num_buffers, self._df.itertuples(index=False),
+                       seed=seed))
+        return HivemallFrame(pd.DataFrame(rows, columns=list(self._df.columns)))
+
+    def each_top_k(self, k: int, group_col: str, value_col: str) -> "HivemallFrame":
+        from ..tools import each_top_k as etk
+
+        import pandas as pd
+
+        df = self._df.sort_values(group_col, kind="mergesort")
+        rows_in = ((r[group_col], r[value_col], tuple(r))
+                   for r in df.to_dict("records"))
+        out = [(rank, value) + tuple(payload.values() if isinstance(payload, dict)
+                                     else payload)
+               for rank, value, payload in etk(k, rows_in)]
+        cols = ["rank", "value"] + list(df.columns)
+        return HivemallFrame(pd.DataFrame(out, columns=cols))
+
+
+def hivemall_ops(df) -> HivemallFrame:
+    return HivemallFrame(df)
+
+
+def predict_stream(model, batches: Iterable, features_col: str = "features"
+                   ) -> Iterator[np.ndarray]:
+    """Streaming predict bridge (HivemallStreamingOps analog): yields scores
+    per incoming DataFrame batch."""
+    for batch in batches:
+        yield model.predict(batch[features_col].tolist())

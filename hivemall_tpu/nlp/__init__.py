@@ -1,0 +1,1 @@
+from .tokenizer import tokenize_ja  # noqa: F401

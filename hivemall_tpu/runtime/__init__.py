@@ -1,0 +1,2 @@
+from .cluster import cluster_env, init_cluster  # noqa: F401
+from .metrics import Counter, MetricsRegistry, StopWatch, ThroughputCounter  # noqa: F401

@@ -1,0 +1,70 @@
+"""Multi-host cluster bring-up — the MIX-server-fleet replacement.
+
+The reference deploys a Netty parameter-server fleet via ssh fan-out
+(ref: bin/mixserv_cluster.sh:44-56, conf/MIXSERV_LIST, mixserv/.../MixServer.java:83-200)
+and clients learn the servers from a `-mix host1,host2` option. TPU-native
+there is no server process at all: multi-host runs are SPMD jax processes
+joined through the JAX coordination service, and "mixing" is the psum inside
+the train step (parallel/mix.py). This module is the bin/*.sh analog:
+
+- `init_cluster(coordinator, num_processes, process_id)` — join the cluster
+  (jax.distributed.initialize); afterwards jax.devices() is the global pod
+  and the SAME MixTrainer program scales across hosts with DCN collectives.
+- `cluster_env()` — resolve the same settings from environment variables
+  (HIVEMALL_TPU_COORDINATOR / _NUM_PROCS / _PROC_ID), the MIXSERV_LIST analog.
+- `parse_mix_option("host1,host2")` — accepts the reference's -mix syntax and
+  maps the first host to the coordinator address for API compatibility.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+DEFAULT_PORT = 11212  # kept from MixEnv.java:21 for familiarity
+
+
+def parse_mix_option(mix: str) -> Tuple[str, int]:
+    """-mix "host1[:port][,host2...]" -> (coordinator_host, port)
+    (ref: MixClient parses the same list; here the first entry coordinates)."""
+    first = mix.split(",")[0].strip()
+    if ":" in first:
+        host, port = first.rsplit(":", 1)
+        return host, int(port)
+    return first, DEFAULT_PORT
+
+
+def cluster_env() -> Optional[Tuple[str, int, int]]:
+    coord = os.environ.get("HIVEMALL_TPU_COORDINATOR")
+    if not coord:
+        return None
+    n = int(os.environ.get("HIVEMALL_TPU_NUM_PROCS", "1"))
+    pid = int(os.environ.get("HIVEMALL_TPU_PROC_ID", "0"))
+    return coord, n, pid
+
+
+def init_cluster(coordinator: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None) -> bool:
+    """Join (or no-op for single-process). Returns True if distributed init
+    ran. Safe to call twice."""
+    import jax
+
+    if coordinator is None:
+        env = cluster_env()
+        if env is None:
+            return False
+        coordinator, num_processes, process_id = env
+    if num_processes is None or num_processes <= 1:
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except RuntimeError as e:  # already initialized
+        if "already" in str(e).lower():
+            return True
+        raise

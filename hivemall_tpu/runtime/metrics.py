@@ -1,0 +1,121 @@
+"""Observability: counters, stopwatch, throughput sampling, profiler hooks.
+
+Mirrors the reference's observability surface (SURVEY.md §5):
+- StopWatch elapsed-time logging (ref: utils/datetime/StopWatch.java, used in
+  model load LearnerBaseUDTF.java:217-234)
+- Hadoop Reporter/Counters for progress + iteration counts
+  (ref: UDTFWithOptions.java:59-88, FM iteration counter
+  FactorizationMachineUDTF.java:529-543)
+- the MIX server's ThroughputCounter msgs/sec sampling + JMX MBean registry
+  (ref: mixserv/.../metrics/ThroughputCounter.java:34, MetricsRegistry.java)
+
+Plus the TPU-native upgrade the reference lacks: `trace()` wraps a block in
+the JAX profiler so kernels show up in xprof/TensorBoard.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+
+class StopWatch:
+    def __init__(self, label: str = "") -> None:
+        self.label = label
+        self._start = time.perf_counter()
+
+    def restart(self) -> None:
+        self._start = time.perf_counter()
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._start
+
+    def __str__(self) -> str:
+        return f"{self.label} {self.elapsed() * 1000:.1f} ms"
+
+
+class Counter:
+    """A named monotonic counter (Hadoop Counter analog)."""
+
+    def __init__(self, group: str, name: str) -> None:
+        self.group = group
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def increment(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+
+class ThroughputCounter:
+    """Events/sec sampled over a sliding window (ThroughputCounter analog)."""
+
+    def __init__(self, window_sec: float = 5.0) -> None:
+        self.window = window_sec
+        self._events: list = []
+        self._lock = threading.Lock()
+        self.last_reads_per_sec = 0.0
+
+    def record(self, n: int = 1) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._events.append((now, n))
+            cutoff = now - self.window
+            while self._events and self._events[0][0] < cutoff:
+                self._events.pop(0)
+            span = max(1e-9, now - (self._events[0][0] if self._events else now))
+            self.last_reads_per_sec = sum(c for _, c in self._events) / max(span, 1e-9)
+
+
+class MetricsRegistry:
+    """Process-wide registry (the JMX MBean registry analog); exportable as a
+    plain dict for scraping."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, Counter] = {}
+        self.throughput: Dict[str, ThroughputCounter] = {}
+        self.gauges: Dict[str, float] = {}
+
+    def counter(self, group: str, name: str) -> Counter:
+        key = f"{group}.{name}"
+        if key not in self.counters:
+            self.counters[key] = Counter(group, name)
+        return self.counters[key]
+
+    def meter(self, name: str) -> ThroughputCounter:
+        if name not in self.throughput:
+            self.throughput[name] = ThroughputCounter()
+        return self.throughput[name]
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    def snapshot(self) -> Dict[str, float]:
+        out: Dict[str, float] = dict(self.gauges)
+        for key, c in self.counters.items():
+            out[key] = float(c.value)
+        for name, t in self.throughput.items():
+            out[f"{name}.per_sec"] = t.last_reads_per_sec
+        return out
+
+
+REGISTRY = MetricsRegistry()
+
+
+@contextlib.contextmanager
+def trace(name: str, log_dir: Optional[str] = None) -> Iterator[None]:
+    """Wrap a block in the JAX profiler (xprof trace) when log_dir is given;
+    always records wall time as a gauge."""
+    sw = StopWatch(name)
+    if log_dir:
+        import jax
+
+        with jax.profiler.trace(log_dir):
+            yield
+    else:
+        yield
+    REGISTRY.set_gauge(f"{name}.seconds", sw.elapsed())

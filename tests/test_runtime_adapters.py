@@ -1,0 +1,113 @@
+"""Runtime (cluster/metrics), NLP, and DataFrame-adapter tests."""
+
+import time
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.nlp import tokenize_ja
+from hivemall_tpu.runtime import (Counter, MetricsRegistry, StopWatch,
+                                  ThroughputCounter)
+from hivemall_tpu.runtime.cluster import parse_mix_option
+from hivemall_tpu.runtime.metrics import trace
+
+
+class TestRuntime:
+    def test_stopwatch(self):
+        sw = StopWatch("x")
+        time.sleep(0.01)
+        assert sw.elapsed() >= 0.009
+
+    def test_counters(self):
+        reg = MetricsRegistry()
+        c = reg.counter("train", "iterations")
+        c.increment()
+        c.increment(4)
+        assert reg.snapshot()["train.iterations"] == 5.0
+
+    def test_throughput(self):
+        t = ThroughputCounter(window_sec=10)
+        for _ in range(100):
+            t.record(10)
+        assert t.last_reads_per_sec > 0
+
+    def test_trace_records_gauge(self):
+        from hivemall_tpu.runtime.metrics import REGISTRY
+
+        with trace("unit_test_block"):
+            pass
+        assert "unit_test_block.seconds" in REGISTRY.snapshot()
+
+    def test_parse_mix_option(self):
+        assert parse_mix_option("host1,host2") == ("host1", 11212)
+        assert parse_mix_option("host1:9999") == ("host1", 9999)
+
+
+class TestNlp:
+    def test_tokenize_ja_basic(self):
+        toks = tokenize_ja("日本語のテキストです")
+        assert len(toks) >= 3
+        assert all(t for t in toks)
+
+    def test_tokenize_ja_stopwords(self):
+        toks = tokenize_ja("日本語のテキスト", stopwords=["の"])
+        assert "の" not in toks
+
+    def test_tokenize_ja_modes(self):
+        assert tokenize_ja("東京特許許可局", "search")  # decompounds long kanji runs
+        with pytest.raises(ValueError):
+            tokenize_ja("x", "bogus")
+
+    def test_tokenize_ja_mixed_scripts(self):
+        toks = tokenize_ja("JAXで機械学習2026")
+        assert any("JAX" in t for t in toks)
+
+
+class TestAdapters:
+    def _df(self):
+        import pandas as pd
+
+        rng = np.random.RandomState(0)
+        n, d = 200, 8
+        w = rng.randn(d)
+        X = rng.randn(n, d).astype(np.float32)
+        y = np.sign(X @ w)
+        feats = [[f"{i}:{X[r, i]}" for i in range(d)] for r in range(n)]
+        return pd.DataFrame({"features": feats, "label": y})
+
+    def test_train_via_dataframe(self):
+        from hivemall_tpu.adapters import hivemall_ops
+
+        hf = hivemall_ops(self._df())
+        model = hf.train_arow("features", "label", "-dims 64")
+        scores = model.predict(self._df()["features"].tolist())
+        acc = np.mean(np.sign(scores) == self._df()["label"].to_numpy())
+        assert acc > 0.9
+
+    def test_amplify(self):
+        from hivemall_tpu.adapters import hivemall_ops
+
+        hf = hivemall_ops(self._df())
+        assert len(hf.amplify(3).df) == 600
+
+    def test_grouped_argmin_kld(self):
+        import pandas as pd
+
+        from hivemall_tpu.adapters import hivemall_ops
+
+        df = pd.DataFrame({"feature": ["a", "a", "b"],
+                           "weight": [1.0, 3.0, 5.0],
+                           "covar": [0.01, 1.0, 1.0]})
+        out = hivemall_ops(df).groupby("feature").argmin_kld("weight", "covar")
+        a_val = float(out[out["feature"] == "a"]["value"].iloc[0])
+        assert a_val == pytest.approx((1 / 0.01 + 3) / (1 / 0.01 + 1))
+
+    def test_predict_stream(self):
+        from hivemall_tpu.adapters import hivemall_ops
+        from hivemall_tpu.adapters.dataframe import predict_stream
+
+        df = self._df()
+        model = hivemall_ops(df).train_perceptron("features", "label", "-dims 64")
+        batches = [df.iloc[:50], df.iloc[50:100]]
+        outs = list(predict_stream(model, batches))
+        assert len(outs) == 2 and len(outs[0]) == 50
