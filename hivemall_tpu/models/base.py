@@ -137,11 +137,20 @@ def fit_linear(
     if mode == "minibatch":
         block_size = mini_batch
     step = make_train_step(rule, hyper, mode=mode)
+    # SpaceEfficientDenseModel analog: above 2^24 dims the reference switches
+    # to half-float storage unless -disable_halffloat
+    # (ref: LearnerBaseUDTF.java:172-175); TPU-native that is bf16.
+    import jax.numpy as jnp
+
+    dtype = jnp.float32
+    if dims > (1 << 24) and not cl.has("disable_halffloat"):
+        dtype = jnp.bfloat16
     state = init_linear_state(
         dims,
         use_covariance=rule.use_covariance,
         slot_names=rule.slot_names,
         global_names=rule.global_names,
+        dtype=dtype,
         initial_weights=initial_weights,
         initial_covars=initial_covars,
     )

@@ -1,0 +1,152 @@
+"""Host-side collection substrate.
+
+The reference ships ~4k LoC of hand-written open-addressing maps and helper
+structures (ref: SURVEY.md §2.17: OpenHashMap, Int2FloatOpenHashTable,
+BoundedPriorityQueue, LRUMap, IndexedSet, SparseIntArray...). On the TPU build
+the *hot* lookups became feature-hashed dense arrays + segment ops; what
+remains host-side maps to Python/numpy. These classes keep the same API
+surface for the places that still want them (top-k, vocab interning, caching).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from collections import OrderedDict
+from typing import Any, Dict, Generic, Iterable, Iterator, List, Optional, TypeVar
+
+import numpy as np
+
+T = TypeVar("T")
+
+
+class BoundedPriorityQueue(Generic[T]):
+    """Keep the k largest items (ref: utils/collections/BoundedPriorityQueue.java,
+    used by each_top_k, tools/EachTopKUDTF.java:48-57)."""
+
+    def __init__(self, k: int):
+        if k <= 0:
+            raise ValueError("k must be positive")
+        self.k = k
+        self._heap: List = []
+        self._counter = itertools.count()
+
+    def offer(self, priority: float, item: T = None) -> bool:
+        entry = (priority, next(self._counter), item)
+        if len(self._heap) < self.k:
+            heapq.heappush(self._heap, entry)
+            return True
+        if entry[0] > self._heap[0][0]:
+            heapq.heappushpop(self._heap, entry)
+            return True
+        return False
+
+    def drain_descending(self) -> List:
+        out = sorted(self._heap, key=lambda e: (e[0], e[1]), reverse=True)
+        self._heap = []
+        return [(p, item) for p, _, item in out]
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+
+class LRUMap(OrderedDict):
+    """Fixed-capacity LRU (ref: utils/collections/LRUMap.java)."""
+
+    def __init__(self, capacity: int):
+        super().__init__()
+        self.capacity = capacity
+
+    def __setitem__(self, key, value):
+        if key in self:
+            super().__delitem__(key)
+        elif len(self) >= self.capacity:
+            self.popitem(last=False)
+        super().__setitem__(key, value)
+
+    def __getitem__(self, key):
+        value = super().__getitem__(key)
+        self.move_to_end(key)
+        return value
+
+
+class IndexedSet(Generic[T]):
+    """Intern values to dense int ids (ref: utils/collections/IndexedSet.java) —
+    the string-vocabulary front end of the hashed feature space."""
+
+    def __init__(self) -> None:
+        self._map: Dict[T, int] = {}
+        self._items: List[T] = []
+
+    def add(self, item: T) -> int:
+        idx = self._map.get(item)
+        if idx is None:
+            idx = len(self._items)
+            self._map[item] = idx
+            self._items.append(item)
+        return idx
+
+    def index_of(self, item: T) -> int:
+        return self._map.get(item, -1)
+
+    def get(self, idx: int) -> T:
+        return self._items[idx]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self) -> Iterator[T]:
+        return iter(self._items)
+
+
+class OpenHashMap(dict):
+    """API-parity alias: Python dicts are already open-addressed hash maps
+    (ref: utils/collections/OpenHashMap.java)."""
+
+
+class SparseIntArray:
+    """Sparse int->int array with dense export
+    (ref: utils/collections/SparseIntArray.java)."""
+
+    def __init__(self) -> None:
+        self._map: Dict[int, int] = {}
+
+    def put(self, idx: int, value: int) -> None:
+        self._map[idx] = value
+
+    def get(self, idx: int, default: int = 0) -> int:
+        return self._map.get(idx, default)
+
+    def increment(self, idx: int, by: int = 1) -> None:
+        self._map[idx] = self._map.get(idx, 0) + by
+
+    def to_dense(self, size: Optional[int] = None) -> np.ndarray:
+        n = size if size is not None else (max(self._map) + 1 if self._map else 0)
+        out = np.zeros(n, dtype=np.int64)
+        for k, v in self._map.items():
+            if k < n:
+                out[k] = v
+        return out
+
+
+class ReservoirSampler(Generic[T]):
+    """Uniform k-sample over a stream (ref: common/ReservoirSampler.java:32)."""
+
+    def __init__(self, k: int, seed: int = 31):
+        self.k = k
+        self._rng = np.random.RandomState(seed)
+        self._samples: List[T] = []
+        self._seen = 0
+
+    def add(self, item: T) -> None:
+        self._seen += 1
+        if len(self._samples) < self.k:
+            self._samples.append(item)
+        else:
+            j = self._rng.randint(0, self._seen)
+            if j < self.k:
+                self._samples[j] = item
+
+    @property
+    def samples(self) -> List[T]:
+        return list(self._samples)

@@ -1,0 +1,64 @@
+"""Collection-substrate tests (ref: utils/collections/*Test, common/ReservoirSampler)."""
+
+import numpy as np
+
+from hivemall_tpu.utils.collections import (BoundedPriorityQueue, IndexedSet,
+                                            LRUMap, ReservoirSampler,
+                                            SparseIntArray)
+
+
+def test_bounded_priority_queue():
+    q = BoundedPriorityQueue(3)
+    for p in [5, 1, 9, 3, 7]:
+        q.offer(p, f"v{p}")
+    out = q.drain_descending()
+    assert [p for p, _ in out] == [9, 7, 5]
+
+
+def test_lru_map():
+    m = LRUMap(2)
+    m["a"] = 1
+    m["b"] = 2
+    _ = m["a"]  # touch
+    m["c"] = 3  # evicts b
+    assert "b" not in m and "a" in m and "c" in m
+
+
+def test_indexed_set():
+    s = IndexedSet()
+    assert s.add("x") == 0
+    assert s.add("y") == 1
+    assert s.add("x") == 0
+    assert s.index_of("y") == 1 and s.index_of("z") == -1
+    assert s.get(1) == "y"
+
+
+def test_sparse_int_array():
+    a = SparseIntArray()
+    a.put(5, 10)
+    a.increment(5)
+    a.increment(2)
+    dense = a.to_dense(8)
+    assert dense[5] == 11 and dense[2] == 1 and dense[0] == 0
+
+
+def test_reservoir_sampler_uniformity():
+    counts = np.zeros(10)
+    for seed in range(300):
+        rs = ReservoirSampler(3, seed=seed)
+        for i in range(10):
+            rs.add(i)
+        for s in rs.samples:
+            counts[s] += 1
+    # each of 10 items expected in ~30% of samples of size 3
+    assert counts.min() > 40 and counts.max() < 180
+
+
+def test_bf16_storage_above_2_24():
+    # SpaceEfficientDenseModel analog is exercised cheaply via init dtype
+    import jax.numpy as jnp
+
+    from hivemall_tpu.core.state import init_linear_state
+
+    st = init_linear_state(64, dtype=jnp.bfloat16)
+    assert st.weights.dtype == jnp.bfloat16
