@@ -1,0 +1,71 @@
+"""CTR scoring metrics — NWMAE / WRMSE / click-AUC.
+
+The reference ships the KDD Cup 2012 Track 2 scorer with its CTR example
+(ref: resources/examples/kddtrack2/scoreKDD.py: impression-weighted MAE/RMSE
+against clicks/impressions, and AUC where each (clicks, impressions) row
+contributes `clicks` positives and `impressions - clicks` negatives). Same
+metrics, vectorized.
+
+CLI-compatible: `python examples/score_ctr.py solution.csv submission.csv`
+with solution rows "clicks,impressions" and submission rows "predicted_ctr".
+"""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+
+def score_nwmae(clicks, impressions, predicted_ctr) -> float:
+    c = np.asarray(clicks, float)
+    n = np.asarray(impressions, float)
+    p = np.asarray(predicted_ctr, float)
+    return float(np.sum(np.abs(c / n - p) * n) / np.sum(n))
+
+
+def score_wrmse(clicks, impressions, predicted_ctr) -> float:
+    c = np.asarray(clicks, float)
+    n = np.asarray(impressions, float)
+    p = np.asarray(predicted_ctr, float)
+    return float(np.sqrt(np.sum((c / n - p) ** 2 * n) / np.sum(n)))
+
+
+def score_click_auc(clicks, impressions, predicted_ctr) -> float:
+    """AUC with each row expanded to `clicks` positives and
+    `impressions - clicks` negatives, ties bucketed by equal prediction."""
+    c = np.asarray(clicks, float)
+    n = np.asarray(impressions, float)
+    p = np.asarray(predicted_ctr, float)
+    order = np.argsort(-p, kind="mergesort")
+    c, n, p = c[order], n[order], p[order]
+    no_click = n - c
+    # group ties: rows with equal prediction form one bucket
+    boundaries = np.nonzero(np.diff(p))[0] + 1
+    groups = np.split(np.arange(len(p)), boundaries)
+    auc_temp = 0.0
+    click_sum = 0.0
+    no_click_sum = 0.0
+    for g in groups:
+        g_clicks = float(c[g].sum())
+        g_noclicks = float(no_click[g].sum())
+        auc_temp += (click_sum + click_sum + g_clicks) * g_noclicks / 2.0
+        click_sum += g_clicks
+        no_click_sum += g_noclicks
+    return auc_temp / (click_sum * no_click_sum)
+
+
+def main() -> None:
+    if len(sys.argv) != 3:
+        print("Usage: python score_ctr.py solution_file.csv submission_file.csv")
+        sys.exit(2)
+    sol = np.loadtxt(sys.argv[1], delimiter=",", skiprows=0)
+    clicks, impressions = sol[:, 0], sol[:, 1]
+    predicted = np.loadtxt(sys.argv[2], delimiter=",", ndmin=1)
+    print("AUC  : %f" % score_click_auc(clicks, impressions, predicted))
+    print("NWMAE: %f" % score_nwmae(clicks, impressions, predicted))
+    print("WRMSE: %f" % score_wrmse(clicks, impressions, predicted))
+
+
+if __name__ == "__main__":
+    main()
