@@ -1,0 +1,43 @@
+"""Math substrate tests (ref: utils/math/*)."""
+
+import math
+
+import pytest
+
+from hivemall_tpu.utils import math as hm
+
+
+def test_bits_required():
+    assert hm.bits_required(1) == 1
+    assert hm.bits_required(255) == 8
+    assert hm.bits_required(256) == 9
+
+
+def test_modulo_power_of_two():
+    assert hm.modulo_power_of_two(10, 8) == 2
+    # two's complement behavior for negatives, like Java's & mask
+    assert hm.modulo_power_of_two(-1, 16) == 15
+
+
+def test_powers():
+    assert hm.is_power_of_two(16) and not hm.is_power_of_two(12)
+    assert hm.next_power_of_two(17) == 32
+
+
+def test_primes():
+    assert hm.next_prime(10) == 11
+    assert hm.next_prime(11) == 11
+    assert hm.is_prime(2) and not hm.is_prime(9)
+
+
+def test_inverse_erf():
+    for x in [-0.9, -0.5, 0.0, 0.3, 0.77]:
+        assert math.erf(hm.inverse_erf(x)) == pytest.approx(x, abs=1e-6)
+
+
+def test_probit():
+    assert hm.probit(0.5) == pytest.approx(0.0, abs=1e-9)
+    assert hm.probit(0.975) == pytest.approx(1.9599, abs=1e-3)
+    assert hm.probit(0.0) == -5.0 and hm.probit(1.0) == 5.0
+    with pytest.raises(ValueError):
+        hm.probit(1.5)
