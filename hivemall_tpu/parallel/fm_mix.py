@@ -1,0 +1,98 @@
+"""Data-parallel FM training with collective mixing.
+
+The north-star workload trains AROW *and* FM across workers (BASELINE.json).
+For FM the mixable state is (w0, w[D], V[D,k]): replicas train on their data
+shards and mix every k blocks —
+
+- w: delta-weighted average over per-feature update counts (every FM row
+  updates all its features, so counts = touch counts), like PartialAverage;
+- V: averaged with the same per-feature weights broadcast over factors;
+- w0: plain mean (every row updates it);
+- AdaGrad-style slots are NOT mixed (device-local, like the reference where
+  optimizer state never crossed the MIX wire — only weights did,
+  ref: MixMessage carries weight/covar only, mix/MixMessage.java:26-95).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..models.fm import FMHyper, FMState, init_fm_state, make_fm_step
+from .mesh import WORKER_AXIS, make_mesh
+
+
+class FMMixTrainer:
+    def __init__(self, hyper: FMHyper, dims: int, mesh: Optional[Mesh] = None,
+                 mode: str = "minibatch", axis_name: str = WORKER_AXIS):
+        self.hyper = hyper
+        self.dims = dims
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_dev = self.mesh.devices.size
+        self.axis = axis_name
+
+        # raw (unjitted) local step: rebuild without jit wrapper
+        local_step = make_fm_step(hyper, mode)
+        # make_fm_step returns a jitted fn; jitted fns compose fine inside
+        # shard_map (they inline at trace time)
+
+        def device_step(state: FMState, indices, values, labels, va):
+            st = jax.tree.map(lambda x: x[0], state)
+            blocks = (indices[0], values[0], labels[0], va[0])
+
+            def body(s, blk):
+                s, loss = local_step(s, *blk)
+                return s, loss
+
+            st, losses = jax.lax.scan(body, st, blocks)
+            # ---- mix ----
+            counts = st.touched.astype(jnp.float32)
+            total = jax.lax.psum(counts, self.axis)
+            w = jnp.where(total > 0,
+                          jax.lax.psum(st.w * counts, self.axis)
+                          / jnp.maximum(total, 1.0), st.w)
+            v = jnp.where(total[:, None] > 0,
+                          jax.lax.psum(st.v * counts[:, None], self.axis)
+                          / jnp.maximum(total, 1.0)[:, None], st.v)
+            w0 = jax.lax.pmean(st.w0, self.axis)
+            st = st.replace(w=w, v=v, w0=w0)
+            return jax.tree.map(lambda x: x[None], st), jax.lax.psum(
+                jnp.sum(losses), self.axis)
+
+        spec_state = jax.tree.map(lambda _: P(self.axis),
+                                  jax.eval_shape(lambda: init_fm_state(dims, hyper)))
+        self._step = jax.jit(
+            jax.shard_map(
+                device_step,
+                mesh=self.mesh,
+                in_specs=(spec_state, P(self.axis), P(self.axis), P(self.axis),
+                          P(self.axis)),
+                out_specs=(spec_state, P()),
+            ),
+            donate_argnums=(0,),
+        )
+
+    def init(self) -> FMState:
+        one = init_fm_state(self.dims, self.hyper)
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (self.n_dev,) + x.shape), one)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(
+                self.mesh, P(*((self.axis,) + (None,) * (x.ndim - 1))))), stacked)
+
+    def step(self, state: FMState, indices, values, labels, va=None):
+        """indices/values/labels: [n_dev, k, B, ...]."""
+        if va is None:
+            va = np.zeros(labels.shape, np.float32)
+        return self._step(state, indices, values, labels, va)
+
+    def final_state(self, state: FMState) -> FMState:
+        host = jax.device_get(state)
+        merged = jax.tree.map(lambda x: x[0], host)
+        return merged.replace(touched=np.max(np.asarray(host.touched), axis=0))

@@ -273,6 +273,9 @@ REGISTRY: Dict[str, Callable] = {
     "train_ffm": train_ffm,
     "ffm_predict": ffm_predict,
     # trees (§2.8)
+    # nlp (ref: resources/ddl/define-additional.hive:9-10)
+    "tokenize_ja": __import__("hivemall_tpu.nlp", fromlist=["tokenize_ja"]).tokenize_ja,
+    # trees (§2.8)
     "train_randomforest_classifier": train_randomforest_classifier,
     "train_randomforest_regressor": train_randomforest_regr,
     "train_randomforest_regr": train_randomforest_regr,
