@@ -1,0 +1,119 @@
+"""Hand-computed exact-update parity tests against the reference formulas.
+
+Each case works the closed-form update out by hand from the cited reference
+code and asserts our kernel reproduces it bit-for-bit (within f32), the way
+PerceptronUDTFTest checks exact weights (ref: SURVEY.md §4)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models import classifier as C
+from hivemall_tpu.models import fm as FM
+
+
+def test_cw_single_update_exact():
+    # CW, phi = 1, first row x = (1,), y = +1, w = 0, cov = 1
+    # score = 0, var = 1
+    # b = 1 + 2*phi*score = 1
+    # gamma = (-b + sqrt(b^2 - 8*phi*(score - phi*var))) / (4*phi*var)
+    #       = (-1 + sqrt(1 + 8)) / 4 = 0.5  (ref: ConfidenceWeightedUDTF.java:126-136)
+    # w' = gamma*y*cov*x = 0.5
+    # cov' = 1/(1/cov + 2*gamma*phi*x^2) = 1/(1+1) = 0.5  (ref: :161)
+    model = C.train_cw(([np.array([0])], [np.array([1.0])]), [1], "-dims 4 -phi 1.0")
+    feats, w, cov = model.model_rows()
+    assert w[0] == pytest.approx(0.5, rel=1e-6)
+    assert cov[0] == pytest.approx(0.5, rel=1e-6)
+
+
+def test_scw1_single_update_exact():
+    # SCW1, phi = 1, c = 1, first row x = (1,), y = +1: m = 0, var = 1
+    # loss = phi*sqrt(var) - y*m = 1 > 0
+    # psi = 1.5, zeta = 2
+    # alpha_numer = -m*psi + sqrt(m^2 phi^4/4 + var phi^2 zeta) = sqrt(2)
+    # alpha = sqrt(2)/(var*zeta) = sqrt(2)/2 ~= 0.7071
+    # reference applies max(c, alpha) -> max(1, 0.7071) = 1  (ref: SoftConfideceWeightedUDTF.java:186)
+    # beta_numer = alpha*phi = 1; var_alpha_phi = 1
+    # u = -1 + sqrt(1 + 4) = sqrt(5) - 1
+    # beta = 1 / (u/2 + 1) = 1 / ((sqrt(5)+1)/2)
+    # w' = y*alpha*cov*x = 1
+    # cov' = cov - beta*(cov*x)^2 = 1 - beta
+    model = C.train_scw(([np.array([0])], [np.array([1.0])]), [1],
+                        "-dims 4 -phi 1.0 -c 1.0")
+    feats, w, cov = model.model_rows()
+    beta = 1.0 / ((math.sqrt(5.0) - 1.0) / 2.0 + 1.0)
+    assert w[0] == pytest.approx(1.0, rel=1e-5)
+    assert cov[0] == pytest.approx(1.0 - beta, rel=1e-5)
+
+
+def test_adagrad_rda_single_update_exact():
+    # AdaGradRDA eta=0.1, lambda=1e-6, scale=100; row x=(1,), y=+1
+    # hinge loss = 1 > 0 -> update. gradient = -y*x = -1
+    # scaled_g = -100; u (scaled) = -100; G (scaled) = 10000
+    # sum_grad = u*scale = -10000; sum_sqgrad = G*scale = 1e6
+    # sign = -1; mog = |sum_grad|/t - lambda = 10000 - 1e-6 (t = 1)
+    # w = -sign*eta*t*mog/sqrt(sum_sqgrad) = 0.1*(10000-1e-6)/1000 ~= 1.0
+    # (ref: AdaGradRDAUDTF.java:104-141)
+    model = C.train_adagrad_rda(([np.array([0])], [np.array([1.0])]), [1],
+                                "-dims 4 -eta 0.1")
+    feats, w = model.model_rows()
+    assert w[0] == pytest.approx(0.1 * (10000 - 1e-6) / 1000.0, rel=1e-4)
+
+
+def test_fm_prediction_formula_exact():
+    # p = w0 + sum w_i x_i + 1/2 sum_f [(sum V_if x_i)^2 - sum V_if^2 x_i^2]
+    # (ref: FactorizationMachineModel.java:136-160)
+    import jax.numpy as jnp
+
+    from hivemall_tpu.models.fm import FMHyper, FMState, _fm_scores
+
+    w0 = 0.3
+    w = np.array([0.1, -0.2, 0.0, 0.4], np.float32)
+    v = np.array([[0.1, 0.2], [0.3, -0.1], [0.0, 0.0], [-0.2, 0.5]], np.float32)
+    state = FMState(
+        w0=jnp.asarray(w0), w=jnp.asarray(w), v=jnp.asarray(v),
+        lambda_w0=jnp.zeros(()), lambda_w=jnp.zeros(()),
+        lambda_v=jnp.zeros((2,)), touched=jnp.zeros((4,), jnp.int8),
+        step=jnp.zeros((), jnp.int32))
+    idx = np.array([[0, 1, 3]], np.int32)
+    val = np.array([[1.0, 2.0, 0.5]], np.float32)
+    x = np.zeros(4)
+    x[[0, 1, 3]] = [1.0, 2.0, 0.5]
+    expected = w0 + float(w @ x)
+    for f in range(2):
+        s = float(np.sum(v[:, f] * x))
+        s2 = float(np.sum((v[:, f] * x) ** 2))
+        expected += 0.5 * (s * s - s2)
+    got = float(np.asarray(_fm_scores(state, idx, val))[0])
+    assert got == pytest.approx(expected, rel=1e-6)
+
+
+def test_multiclass_margin_update_exact():
+    # multiclass PA: two classes a/b, row x=(1,), label a
+    # scores all 0 -> margin m = 0 - 0 = 0, loss = 1 - m = 1
+    # eta = loss / (2*|x|^2) = 0.5 (ref: MulticlassPassiveAggressiveUDTF.java:70-72)
+    # w[a] += 0.5, w[missed] -= 0.5
+    from hivemall_tpu.models import multiclass as MC
+
+    model = MC.train_multiclass_pa(
+        ([np.array([0]), np.array([1])], [np.array([1.0]), np.array([1.0])]),
+        ["a", "b"], "-dims 8")
+    labels, feats, w = model.model_rows()
+    m = {(l, f): x for l, f, x in zip(labels, feats.tolist(), w.tolist())}
+    assert m[("a", 0)] == pytest.approx(0.5)
+    assert m[("b", 0)] == pytest.approx(-0.5)
+
+
+def test_logress_invscaling_schedule_exact():
+    # two rows; eta(t) = 0.1 / t^0.1 (ref: EtaEstimator.InvscalingEtaEstimator)
+    rows = ([np.array([0]), np.array([0])], [np.array([1.0]), np.array([1.0])])
+    from hivemall_tpu.models.regression import train_logistic_regr
+
+    model = train_logistic_regr(rows, [1.0, 1.0], "-dims 4")
+    # t=1: grad = 1 - sigmoid(0) = 0.5, w1 = 0.1*0.5 = 0.05
+    # t=2: p = 0.05, grad = 1 - sigmoid(0.05), eta = 0.1/2^0.1
+    g2 = 1.0 - 1.0 / (1.0 + math.exp(-0.05))
+    w2 = 0.05 + (0.1 / 2 ** 0.1) * g2
+    _, w = model.model_rows()
+    assert w[0] == pytest.approx(w2, rel=1e-5)
