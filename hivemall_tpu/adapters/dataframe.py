@@ -62,14 +62,47 @@ class _GroupedOps:
 
         return self._agg(rf_ensemble, col)
 
-    def mae(self, pred_col: str, actual_col: str):
-        from ..evaluation import mae
+    def maxrow(self, compare_col: str):
+        from ..ensemble import maxrow as mr
 
         import pandas as pd
 
-        rows = [(k, mae(g[pred_col], g[actual_col]))
+        cols = list(self._df.columns)
+        ci = cols.index(compare_col)
+        rows = [(k,) + tuple(mr([tuple(r) for r in g.itertuples(index=False)], ci))
                 for k, g in self._df.groupby(self._by)]
-        return pd.DataFrame(rows, columns=[self._by, "mae"])
+        return pd.DataFrame(rows, columns=["group"] + cols)
+
+    def _metric(self, fn, pred_col: str, actual_col: str, name: str):
+        import pandas as pd
+
+        rows = [(k, fn(g[pred_col], g[actual_col]))
+                for k, g in self._df.groupby(self._by)]
+        return pd.DataFrame(rows, columns=[self._by, name])
+
+    def mae(self, pred_col: str, actual_col: str):
+        from ..evaluation import mae
+
+        return self._metric(mae, pred_col, actual_col, "mae")
+
+    def mse(self, pred_col: str, actual_col: str):
+        from ..evaluation import mse
+
+        return self._metric(mse, pred_col, actual_col, "mse")
+
+    def rmse(self, pred_col: str, actual_col: str):
+        from ..evaluation import rmse
+
+        return self._metric(rmse, pred_col, actual_col, "rmse")
+
+    def f1score(self, actual_col: str, pred_col: str):
+        from ..evaluation import f1score
+
+        import pandas as pd
+
+        rows = [(k, f1score(g[actual_col].tolist(), g[pred_col].tolist()))
+                for k, g in self._df.groupby(self._by)]
+        return pd.DataFrame(rows, columns=[self._by, "f1score"])
 
 
 class HivemallFrame:

@@ -42,6 +42,15 @@ def base_options() -> Options:
     o.add("disable_halffloat", None, False, "(accepted for parity; TPU uses fp32/bf16)")
     o.add("loadmodel", None, True,
           "Warm-start from a saved model-rows table (ref: LearnerBaseUDTF.java:215-333)")
+    # MIX client options accepted for signature parity
+    # (ref: LearnerBaseUDTF.java:92-103). In the TPU build, model mixing is a
+    # collective inside the train step — use parallel.MixTrainer on a mesh
+    # (and runtime.init_cluster for multi-host) instead of a server fleet.
+    o.add("mix", "mix_servers", True, "(parity) MIX server list; see parallel.MixTrainer")
+    o.add("mix_session", "mix_session_name", True, "(parity) MIX session name")
+    o.add("mix_threshold", None, True, "(parity) MIX push threshold", type=int)
+    o.add("mix_cancel", "enable_mix_canceling", False, "(parity) no-op under sync SPMD")
+    o.add("ssl", None, False, "(parity) TLS handled by the deployment, not the library")
     o.add("mini_batch", "mini_batch_size", True,
           "Mini batch size [default: 1 = exact per-row scan]", default=1, type=int)
     o.add("iters", "iterations", True, "Number of epochs [default: 1]", default=1, type=int)
