@@ -1,0 +1,145 @@
+"""Sharded binary record files — the input-pipeline / epoch-replay subsystem.
+
+The reference replays epochs by spilling every training row to a NIO
+positioned temp file and re-reading it in close()
+(ref: utils/io/NioStatefullSegment.java:29-68, fm/FactorizationMachineUDTF.java:291-332,
+mf/OnlineMatrixFactorizationUDTF.java:92-203). TPU-first this becomes a
+proper record-shard pipeline (SURVEY.md §2.17 io note): rows are written once
+to N binary shards; epochs iterate shards with shard-order + in-shard
+shuffling and yield fixed-shape FeatureBlocks, optionally prefetched to
+device ahead of the consumer.
+
+Record format (little-endian), per row:
+    u8  nnz | varint delta-coded feature ids | f32[nnz] values | f32 label
+Shard file: magic "HMTR1" + u64 row count + rows.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.batch import FeatureBlock, iter_blocks, pad_to_bucket
+from ..utils.codec import leb128_decode, leb128_encode
+
+MAGIC = b"HMTR1"
+
+
+def write_records(prefix: str, idx_rows: Sequence[np.ndarray],
+                  val_rows: Sequence[np.ndarray], labels: Sequence[float],
+                  num_shards: int = 1) -> List[str]:
+    """Round-robin rows into `num_shards` files `prefix-{i:05d}.hmtr`."""
+    paths = [f"{prefix}-{i:05d}.hmtr" for i in range(num_shards)]
+    bufs: List[bytearray] = [bytearray() for _ in range(num_shards)]
+    counts = [0] * num_shards
+    for r, (idx, val) in enumerate(zip(idx_rows, val_rows)):
+        s = r % num_shards
+        out = bufs[s]
+        idx = np.asarray(idx, np.int64)
+        order = np.argsort(idx)
+        idx = idx[order]
+        val = np.asarray(val, np.float32)[order]
+        if len(idx) > 255:
+            raise ValueError("row nnz > 255 unsupported by record format")
+        out.append(len(idx))
+        prev = 0
+        for i in idx:
+            leb128_encode(int(i) - prev, out)
+            prev = int(i)
+        out.extend(val.tobytes())
+        out.extend(struct.pack("<f", float(labels[r])))
+        counts[s] += 1
+    for p, buf, c in zip(paths, bufs, counts):
+        with open(p, "wb") as f:
+            f.write(MAGIC)
+            f.write(struct.pack("<Q", c))
+            f.write(bytes(buf))
+    return paths
+
+
+def read_shard(path: str) -> Tuple[List[np.ndarray], List[np.ndarray], np.ndarray]:
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:5] != MAGIC:
+        raise ValueError(f"{path}: bad magic")
+    (n,) = struct.unpack_from("<Q", data, 5)
+    pos = 13
+    idx_rows: List[np.ndarray] = []
+    val_rows: List[np.ndarray] = []
+    labels = np.empty(n, np.float32)
+    for r in range(n):
+        nnz = data[pos]
+        pos += 1
+        idx = np.empty(nnz, np.int64)
+        prev = 0
+        for k in range(nnz):
+            d, pos = leb128_decode(data, pos)
+            prev += d
+            idx[k] = prev
+        val = np.frombuffer(data, np.float32, count=nnz, offset=pos).copy()
+        pos += 4 * nnz
+        (labels[r],) = struct.unpack_from("<f", data, pos)
+        pos += 4
+        idx_rows.append(idx)
+        val_rows.append(val)
+    return idx_rows, val_rows, labels
+
+
+class RecordDataset:
+    """Epoch iterator over record shards with shuffling + fixed-shape blocks.
+
+    `device_prefetch` stages the next block's arrays on device while the
+    current one computes (the double-buffering the reference's synchronous
+    disk replay lacked)."""
+
+    def __init__(self, paths: Sequence[str], dims: int, batch_size: int,
+                 width: Optional[int] = None, shuffle: bool = True,
+                 seed: int = 31, device_prefetch: bool = True):
+        self.paths = list(paths)
+        self.dims = dims
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.device_prefetch = device_prefetch
+        self._width = width
+        self._epoch = 0
+
+    def _resolve_width(self, idx_rows) -> int:
+        if self._width is None:
+            self._width = pad_to_bucket(max((len(r) for r in idx_rows), default=1))
+        return self._width
+
+    def blocks(self) -> Iterator[FeatureBlock]:
+        rng = np.random.RandomState(self.seed + self._epoch)
+        self._epoch += 1
+        order = rng.permutation(len(self.paths)) if self.shuffle else \
+            np.arange(len(self.paths))
+
+        def host_blocks():
+            for s in order:
+                idx_rows, val_rows, labels = read_shard(self.paths[s])
+                if self.shuffle:
+                    perm = rng.permutation(len(idx_rows))
+                    idx_rows = [idx_rows[i] for i in perm]
+                    val_rows = [val_rows[i] for i in perm]
+                    labels = labels[perm]
+                width = self._resolve_width(idx_rows)
+                yield from iter_blocks(idx_rows, val_rows, labels, self.dims,
+                                       self.batch_size, width)
+
+        if not self.device_prefetch:
+            yield from host_blocks()
+            return
+        import jax
+
+        pending = None
+        for blk in host_blocks():
+            staged = FeatureBlock(*(jax.device_put(a) for a in blk))
+            if pending is not None:
+                yield pending
+            pending = staged
+        if pending is not None:
+            yield pending
