@@ -66,6 +66,14 @@ def read_shard(path: str) -> Tuple[List[np.ndarray], List[np.ndarray], np.ndarra
     if data[:5] != MAGIC:
         raise ValueError(f"{path}: bad magic")
     (n,) = struct.unpack_from("<Q", data, 5)
+    from .. import native
+
+    decoded = native.decode_records(data[13:], n)
+    if decoded is not None:
+        offsets, indices, values, labels = decoded
+        idx_rows = [indices[offsets[r]:offsets[r + 1]] for r in range(n)]
+        val_rows = [values[offsets[r]:offsets[r + 1]] for r in range(n)]
+        return idx_rows, val_rows, labels
     pos = 13
     idx_rows: List[np.ndarray] = []
     val_rows: List[np.ndarray] = []

@@ -37,6 +37,11 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
+    lib.hm_decode_records.restype = ctypes.c_int64
+    lib.hm_decode_records.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
     _lib = lib
     return lib
 
@@ -69,6 +74,32 @@ def murmur3_bulk(strings: Sequence[bytes], num_features: int,
         offsets.ctypes.data_as(ctypes.c_void_p), n, seed, num_features,
         out.ctypes.data_as(ctypes.c_void_p))
     return out
+
+
+def decode_records(body: bytes, n_rows: int):
+    """Decode a HMTR1 shard body -> (row_offsets, indices, values, labels),
+    or None without the library."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(body, dtype=np.uint8)
+    total = lib.hm_decode_records(buf.ctypes.data_as(ctypes.c_void_p), len(body),
+                                  n_rows, None, None, None, None)
+    if total < 0:
+        raise ValueError("corrupt record shard")
+    offsets = np.empty(n_rows + 1, np.int64)
+    indices = np.empty(total, np.int64)
+    values = np.empty(total, np.float32)
+    labels = np.empty(n_rows, np.float32)
+    out = lib.hm_decode_records(
+        buf.ctypes.data_as(ctypes.c_void_p), len(body), n_rows,
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        indices.ctypes.data_as(ctypes.c_void_p),
+        values.ctypes.data_as(ctypes.c_void_p),
+        labels.ctypes.data_as(ctypes.c_void_p))
+    if out != total:
+        raise ValueError("corrupt record shard")
+    return offsets, indices, values, labels
 
 
 def pack_block(idx_rows: Sequence[np.ndarray], val_rows: Sequence[np.ndarray],

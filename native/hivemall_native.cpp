@@ -110,6 +110,47 @@ void hm_pack_block(const int64_t* indices, const float* values,
     }
 }
 
+// ------------------------------------------------------------- record shards
+
+// Decode the body of a HMTR1 record shard (hivemall_tpu/io/records.py):
+// per row: u8 nnz | varint delta ids | f32[nnz] values | f32 label.
+// Pass 1 (out_* null): returns total nnz. Pass 2: fills row_offsets[n+1],
+// indices/values[total_nnz], labels[n]. Returns total nnz, or -1 on corrupt
+// input.
+int64_t hm_decode_records(const uint8_t* data, int64_t len, int64_t n_rows,
+                          int64_t* row_offsets, int64_t* indices, float* values,
+                          float* labels) {
+    int64_t pos = 0;
+    int64_t total = 0;
+    for (int64_t r = 0; r < n_rows; r++) {
+        if (pos >= len) return -1;
+        const int nnz = data[pos++];
+        if (row_offsets) row_offsets[r] = total;
+        int64_t prev = 0;
+        for (int k = 0; k < nnz; k++) {
+            int64_t v = 0;
+            int shift = 0;
+            while (true) {
+                if (pos >= len) return -1;
+                const uint8_t b = data[pos++];
+                v |= static_cast<int64_t>(b & 0x7F) << shift;
+                if (!(b & 0x80)) break;
+                shift += 7;
+            }
+            prev += v;
+            if (indices) indices[total + k] = prev;
+        }
+        if (pos + 4 * nnz + 4 > len) return -1;
+        if (values) std::memcpy(values + total, data + pos, 4 * nnz);
+        pos += 4 * nnz;
+        if (labels) std::memcpy(labels + r, data + pos, 4);
+        pos += 4;
+        total += nnz;
+    }
+    if (row_offsets) row_offsets[n_rows] = total;
+    return total;
+}
+
 // Parse a "idx:value" / "idx" feature byte-string (int features) without
 // Python per-token overhead. Returns 0 on success.
 int32_t hm_parse_int_feature(const uint8_t* s, int64_t len, int64_t* out_idx,
