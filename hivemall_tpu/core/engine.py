@@ -11,9 +11,13 @@ FeatureBlock [B, K]:
 - **minibatch mode** — one vectorized gather [B, K], the rule vmapped over
   rows against the *stale* batch-start weights, deltas scatter-added (averaged
   per feature when `mini_batch_average`). This is exactly the reference's own
-  documented mini-batch semantic (ref: RegressionBaseUDTF.java:236-295:
-  accumulate per-feature deltas over the batch, apply the average once), and
-  is the TPU hot path: one big gather + vectorized math + one big scatter.
+  documented mini-batch semantic (ref: RegressionBaseUDTF.java:236-295 +
+  utils/lang/FloatAccumulator.java:38-41: accumulate per-feature deltas over
+  the batch, apply sum/count once), and is the TPU hot path: one big gather +
+  vectorized math + one big scatter. The reference only routes weight-only
+  regressors through its mini-batch path (covariance learners override
+  train() around it); here every rule supports it — a documented superset,
+  with batch size 1 exactly equal to scan mode.
 
 Padding protocol (see core/batch.py): pad index == dims is out-of-range, so
 gathers use mode='fill' and scatters mode='drop' — no mask tensors anywhere.

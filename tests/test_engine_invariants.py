@@ -1,0 +1,65 @@
+"""Engine invariants: minibatch with batch size 1 must reproduce scan mode
+exactly (same per-row updates, average over one element) for every rule."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.core.engine import make_train_step
+from hivemall_tpu.core.state import init_linear_state
+from hivemall_tpu.models import classifier as C
+from hivemall_tpu.models import regression as R
+
+RULES = [
+    (C.PERCEPTRON, {}, True),
+    (C.PA, {}, True),
+    (C.PA1, {"c": 1.0}, True),
+    (C.PA2, {"c": 1.0}, True),
+    (C.CW, {"phi": 1.0}, True),
+    (C.AROW, {"r": 0.1}, True),
+    (C.AROWH, {"r": 0.1, "c": 1.0}, True),
+    (C.SCW1, {"phi": 1.0, "c": 1.0}, True),
+    (C.SCW2, {"phi": 1.0, "c": 1.0}, True),
+    (C.ADAGRAD_RDA, {"eta": 0.1, "lambda": 1e-6, "scale": 100.0}, True),
+    (R.PA1_REGR, {"c": 1.0, "epsilon": 0.01}, False),
+    (R.PA2_REGR, {"c": 1.0, "epsilon": 0.01}, False),
+    (R.PA1A_REGR, {"c": 1.0, "epsilon": 0.01}, False),
+    (R.AROW_REGR, {"r": 0.1}, False),
+    (R.AROWE2_REGR, {"r": 0.1, "epsilon": 0.01}, False),
+    (R.ADAGRAD_REGR, {"eta": 1.0, "eps": 1.0, "scale": 100.0}, False),
+    (R.ADADELTA_REGR, {"rho": 0.95, "eps": 1e-6, "scale": 100.0}, False),
+]
+
+
+def _data(n=50, d=12, seed=2, binary=True):
+    rng = np.random.RandomState(seed)
+    idx = np.tile(np.arange(d, dtype=np.int32), (n, 1))
+    val = rng.randn(n, d).astype(np.float32)
+    y = np.sign(val.sum(1)).astype(np.float32) if binary else \
+        val.sum(1).astype(np.float32) * 0.1
+    return idx, val, y
+
+
+@pytest.mark.parametrize("rule,hyper,binary", RULES, ids=[r[0].name for r in RULES])
+def test_minibatch1_equals_scan(rule, hyper, binary):
+    idx, val, y = _data(binary=binary)
+    d = 12
+
+    def run(mode):
+        step = make_train_step(rule, hyper, mode=mode, donate=False)
+        st = init_linear_state(d, use_covariance=rule.use_covariance,
+                               slot_names=rule.slot_names,
+                               global_names=rule.global_names)
+        if mode == "scan":
+            st, _ = step(st, idx, val, y)
+        else:
+            for i in range(len(y)):
+                st, _ = step(st, idx[i : i + 1], val[i : i + 1], y[i : i + 1])
+        return st
+
+    s1, s2 = run("scan"), run("minibatch")
+    np.testing.assert_allclose(np.asarray(s1.weights), np.asarray(s2.weights),
+                               rtol=2e-5, atol=1e-6)
+    if rule.use_covariance:
+        np.testing.assert_allclose(np.asarray(s1.covars), np.asarray(s2.covars),
+                                   rtol=2e-5, atol=1e-6)
+    assert int(s1.step) == int(s2.step)
