@@ -25,7 +25,7 @@ from hivemall_tpu.knn.distance import euclid_distance_batch
 def lof(X: np.ndarray, k: int = 10) -> np.ndarray:
     """LOF scores for each row of X (score >> 1 = outlier)."""
     n = X.shape[0]
-    D = np.asarray(euclid_distance_batch(X, X))
+    D = np.asarray(euclid_distance_batch(X, X)).copy()
     np.fill_diagonal(D, np.inf)
     knn_idx = np.argsort(D, axis=1)[:, :k]  # [n, k]
     knn_dist = np.take_along_axis(D, knn_idx, axis=1)  # [n, k]
