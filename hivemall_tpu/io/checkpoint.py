@@ -45,9 +45,34 @@ def load_model_rows(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndar
     if path.endswith(".npz"):
         z = np.load(path)
         return z["feature"], z["weight"], z["covar"] if "covar" in z.files else None
+    if path.endswith((".tsv", ".csv", ".txt")):
+        return _load_text_model_rows(path)
     with open(path, "rb") as f:
         feats, weights = decode_sparse_model(f.read())
     return feats, weights, None
+
+
+def _load_text_model_rows(path: str):
+    """Interchange with the reference: a Hive-exported model table
+    `feature<TAB>weight[<TAB>covar]` (or comma-separated) — the exact file the
+    reference's -loadmodel consumed from the distributed cache
+    (ref: LearnerBaseUDTF.loadPredictionModel:215-333)."""
+    sep = "," if path.endswith(".csv") else "\t"
+    feats, weights, covars = [], [], []
+    has_covar = False
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(sep)
+            feats.append(int(parts[0]))
+            weights.append(float(parts[1]))
+            if len(parts) > 2:
+                covars.append(float(parts[2]))
+                has_covar = True
+    return (np.asarray(feats, np.int64), np.asarray(weights, np.float32),
+            np.asarray(covars, np.float32) if has_covar else None)
 
 
 def dense_from_rows(dims: int, feats: np.ndarray, weights: np.ndarray,

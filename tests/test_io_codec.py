@@ -93,3 +93,19 @@ class TestCheckpoint:
         np.testing.assert_allclose(np.asarray(st.weights), np.asarray(m.state.weights))
         np.testing.assert_allclose(np.asarray(st.covars), np.asarray(m.state.covars))
         assert int(st.step) == int(m.state.step)
+
+
+def test_tsv_model_interchange(tmp_path):
+    """Load a Hive-exported model table (feature\tweight\tcovar) — the
+    reference's -loadmodel input format."""
+    p = tmp_path / "model.tsv"
+    p.write_text("0\t0.5\t0.9\n3\t-1.25\t0.1\n7\t2.0\t1.0\n")
+    f, w, c = load_model_rows(str(p))
+    np.testing.assert_array_equal(f, [0, 3, 7])
+    np.testing.assert_allclose(w, [0.5, -1.25, 2.0])
+    np.testing.assert_allclose(c, [0.9, 0.1, 1.0])
+    # usable as warm start
+    m = train_arow(([np.array([0])], [np.array([0.0])]), [1],
+                   f"-dims 16 -loadmodel {p}")
+    assert np.asarray(m.state.weights)[3] == np.float32(-1.25)
+    assert np.asarray(m.state.covars)[3] == np.float32(0.1)
