@@ -62,6 +62,9 @@ def base_options() -> Options:
           default=4096, type=int)
     o.add("shuffle", None, False, "Shuffle rows between epochs")
     o.add("seed", None, True, "Shuffle seed", default=31, type=int)
+    o.add("pallas", None, False,
+          "Use the VMEM-resident Pallas backend for exact scan mode "
+          "(models that fit on-chip; kernels/linear_scan.py)")
     return o
 
 
@@ -145,7 +148,15 @@ def fit_linear(
     mode = "minibatch" if mini_batch > 1 else "scan"
     if mode == "minibatch":
         block_size = mini_batch
-    step = make_train_step(rule, hyper, mode=mode)
+    if cl.has("pallas") and mode == "scan":
+        import jax
+
+        from ..kernels.linear_scan import make_pallas_scan_step
+
+        interpret = jax.devices()[0].platform != "tpu"
+        step = make_pallas_scan_step(rule, hyper, interpret=interpret)
+    else:
+        step = make_train_step(rule, hyper, mode=mode)
     # SpaceEfficientDenseModel analog: above 2^24 dims the reference switches
     # to half-float storage unless -disable_halffloat
     # (ref: LearnerBaseUDTF.java:172-175); TPU-native that is bf16.

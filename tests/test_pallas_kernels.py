@@ -57,3 +57,73 @@ def test_arow_pallas_sequential_dependence():
     step = make_train_step(AROW, {"r": 0.1}, mode="scan", donate=False)
     ref, _ = step(state, idx, val, y)
     np.testing.assert_allclose(np.asarray(w), np.asarray(ref.weights), rtol=1e-5)
+
+
+RULES_FOR_GENERIC = None
+
+
+def _generic_rules():
+    from hivemall_tpu.models import classifier as C
+    from hivemall_tpu.models import regression as R
+
+    return [
+        (C.PERCEPTRON, {}, True),
+        (C.PA1, {"c": 1.0}, True),
+        (C.AROW, {"r": 0.1}, True),
+        (C.SCW1, {"phi": 1.0, "c": 1.0}, True),
+        (C.ADAGRAD_RDA, {"eta": 0.1, "lambda": 1e-6, "scale": 100.0}, True),
+        (R.AROW_REGR, {"r": 0.1}, False),
+        (R.PA1A_REGR, {"c": 1.0, "epsilon": 0.01}, False),
+        (R.ADAGRAD_REGR, {"eta": 1.0, "eps": 1.0, "scale": 100.0}, False),
+    ]
+
+
+@pytest.mark.parametrize("i", range(8))
+def test_generic_pallas_scan_matches_engine(i):
+    from hivemall_tpu.kernels.linear_scan import make_pallas_scan_step
+
+    rule, hyper, binary = _generic_rules()[i]
+    D = 128
+    idx, val, y = _data(B=48, K=8, D=D, seed=i)
+    if not binary:
+        y = (y * 0.3).astype(np.float32)
+    st0 = init_linear_state(D, use_covariance=rule.use_covariance,
+                            slot_names=rule.slot_names,
+                            global_names=rule.global_names)
+    eng = make_train_step(rule, hyper, mode="scan", donate=False)
+    ref, ref_loss = eng(st0, idx, val, y)
+
+    st1 = init_linear_state(D, use_covariance=rule.use_covariance,
+                            slot_names=rule.slot_names,
+                            global_names=rule.global_names)
+    pstep = make_pallas_scan_step(rule, hyper, interpret=True)
+    got, got_loss = pstep(st1, idx, val, y)
+
+    np.testing.assert_allclose(np.asarray(got.weights), np.asarray(ref.weights),
+                               rtol=1e-5, atol=1e-6)
+    if rule.use_covariance:
+        np.testing.assert_allclose(np.asarray(got.covars), np.asarray(ref.covars),
+                                   rtol=1e-5, atol=1e-6)
+    for s in rule.slot_names:
+        np.testing.assert_allclose(np.asarray(got.slots[s]), np.asarray(ref.slots[s]),
+                                   rtol=1e-5, atol=1e-6)
+    for g in rule.global_names:
+        np.testing.assert_allclose(np.asarray(got.globals[g]),
+                                   np.asarray(ref.globals[g]), rtol=1e-5, atol=1e-6)
+    assert float(got_loss) == pytest.approx(float(ref_loss), rel=1e-5, abs=1e-6)
+    assert int(got.step) == int(ref.step)
+
+
+def test_fit_linear_pallas_option():
+    from hivemall_tpu.models.classifier import train_arow
+
+    rng = np.random.RandomState(0)
+    d, n = 32, 200
+    w = rng.randn(d)
+    idx = [np.arange(d, dtype=np.int64) for _ in range(n)]
+    val = [rng.randn(d).astype(np.float32) for _ in range(n)]
+    y = np.array([np.sign(v @ w) for v in val])
+    m_ref = train_arow((idx, val), y, "-dims 32")
+    m_pal = train_arow((idx, val), y, "-dims 32 -pallas")
+    np.testing.assert_allclose(np.asarray(m_pal.state.weights),
+                               np.asarray(m_ref.state.weights), rtol=1e-5, atol=1e-6)
