@@ -176,6 +176,12 @@ def fit_linear(
     )
 
     conv = ConversionState(not cl.has("disable_cv"), cl.get_float("cv_rate", 0.005))
+    # progress counters, the Hadoop Reporter/Counter analog
+    # (ref: UDTFWithOptions.java:59-88, FM iteration counter :529-543)
+    from ..runtime.metrics import REGISTRY
+
+    iter_counter = REGISTRY.counter("hivemall", f"{rule.name}.iterations")
+    row_counter = REGISTRY.counter("hivemall", f"{rule.name}.examples")
     for it in range(max(1, iters)):
         if cl.has("shuffle") and it > 0:
             idx_rows, val_rows, labels = shuffle_rows(
@@ -185,6 +191,8 @@ def fit_linear(
         for block in iter_blocks(idx_rows, val_rows, labels, dims, block_size, width):
             state, loss = step(state, block.indices, block.values, block.labels)
             epoch_loss += float(loss)
+            row_counter.increment(block.batch_size)
+        iter_counter.increment()
         conv.incr_loss(epoch_loss)
         if iters > 1 and conv.is_converged(n):
             break

@@ -23,6 +23,23 @@ import numpy as np
 from .binning import BinInfo, threshold_of
 from .grow import TreeArrays
 
+# Numeric model-type ids, matching the reference enum (ref: smile/ModelType.java:20-27):
+# positive = uncompressed, negative = compressed variant. Our "json" plays the
+# serialization role off-JVM.
+MODEL_TYPE_IDS = {
+    "opscode": 1,
+    "javascript": 2,
+    "json": 3,  # serialization analog
+    "opscode_compressed": -1,
+    "javascript_compressed": -2,
+    "json_compressed": -3,
+}
+
+
+def model_type_id(name: str, compressed: bool = False) -> int:
+    key = f"{name}_compressed" if compressed else name
+    return MODEL_TYPE_IDS[key]
+
 
 def _op_codegen(tree: TreeArrays, bins: List[BinInfo], node: int,
                 scripts: List[str], depth: int) -> int:

@@ -68,3 +68,26 @@ def init_cluster(coordinator: Optional[str] = None,
         if "already" in str(e).lower():
             return True
         raise
+
+
+def main() -> None:
+    """`python -m hivemall_tpu.runtime.cluster --coordinator host:port
+    --num-procs N --proc-id I` — join the cluster and report the global
+    device view (the start_mixserv.sh analog)."""
+    import argparse
+
+    import jax
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--coordinator", default=None)
+    ap.add_argument("--num-procs", type=int, default=None)
+    ap.add_argument("--proc-id", type=int, default=None)
+    args = ap.parse_args()
+    joined = init_cluster(args.coordinator, args.num_procs, args.proc_id)
+    print(f"distributed={'joined' if joined else 'single-process'} "
+          f"process={jax.process_index()}/{jax.process_count()} "
+          f"devices={len(jax.devices())}")
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,22 @@ def unbase91(text: str, as_text: bool = False) -> Union[bytes, str]:
     return out.decode("utf-8") if as_text else bytes(out)
 
 
+def ascii85(data: Union[bytes, str]) -> str:
+    """Ascii85 encode (ref: utils/io/ASCII85OutputStream.java substrate)."""
+    import base64
+
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return base64.a85encode(data).decode("ascii")
+
+
+def unascii85(text: str, as_text: bool = False) -> Union[bytes, str]:
+    import base64
+
+    out = base64.a85decode(text.encode("ascii"))
+    return out.decode("utf-8") if as_text else out
+
+
 _STOPWORDS = frozenset(
     """a about above after again against all am an and any are aren't as at be
     because been before being below between both but by can't cannot could

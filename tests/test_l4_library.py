@@ -294,3 +294,19 @@ class TestDataset:
         model = train_arow(rows, y, "-dims 30")
         acc = np.mean(np.sign(model.predict(rows)) == y)
         assert acc > 0.8, acc
+
+
+def test_ascii85_roundtrip():
+    from hivemall_tpu.tools.text import ascii85, unascii85
+
+    for payload in [b"", b"hello", bytes(range(100))]:
+        assert unascii85(ascii85(payload)) == payload
+
+
+def test_tree_model_type_ids():
+    from hivemall_tpu.models.trees.export import model_type_id
+
+    assert model_type_id("opscode") == 1
+    assert model_type_id("javascript") == 2
+    assert model_type_id("json") == 3
+    assert model_type_id("opscode", compressed=True) == -1
