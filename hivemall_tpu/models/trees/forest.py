@@ -103,21 +103,20 @@ class TrainedForest:
 
     def predict(self, X) -> np.ndarray:
         """Majority vote (classification) / mean (regression) over trees —
-        what rf_ensemble does over the emitted per-tree predictions."""
+        what rf_ensemble does over the emitted per-tree predictions. All trees
+        evaluate in ONE vmapped device walk (stacked node arrays)."""
+        from .grow import predict_forest_binned, stack_trees
+
         X = np.asarray(X, dtype=np.float64)
         Xb = bin_data(X, self.bins)
+        stacked = stack_trees([t.tree for t in self.trees])
+        leaf_vals = np.asarray(predict_forest_binned(stacked, Xb))  # [T, N]
         if self.classification:
             votes = np.zeros((X.shape[0], self.n_classes))
-            for t in self.trees:
-                leaf = predict_binned(t.tree, Xb)
-                votes[np.arange(X.shape[0]),
-                      t.tree.leaf_value[leaf].astype(int)] += 1
+            for t in range(leaf_vals.shape[0]):
+                votes[np.arange(X.shape[0]), leaf_vals[t].astype(int)] += 1
             return np.argmax(votes, axis=1)
-        preds = np.zeros(X.shape[0])
-        for t in self.trees:
-            leaf = predict_binned(t.tree, Xb)
-            preds += t.tree.leaf_value[leaf]
-        return preds / len(self.trees)
+        return leaf_vals.mean(axis=0)
 
     def model_rows(self):
         """Per-tree rows (model_id, model_type, model, var_importance,
@@ -238,14 +237,18 @@ class TrainedGBT:
     bins: List[BinInfo]
 
     def decision_function(self, X) -> np.ndarray:
+        from .grow import predict_forest_binned, stack_trees
+
         X = np.asarray(X, dtype=np.float64)
         Xb = bin_data(X, self.bins)
         K = len(self.intercept)
         scores = np.tile(self.intercept, (X.shape[0], 1))
-        for round_trees in self.trees:
-            for k, tree in enumerate(round_trees):
-                leaf = predict_binned(tree, Xb)
-                scores[:, k] += self.shrinkage * tree.leaf_value[leaf]
+        flat = [t for round_trees in self.trees for t in round_trees]
+        if flat:
+            leaf_vals = np.asarray(predict_forest_binned(stack_trees(flat), Xb))
+            # rows are (round, class) in order
+            contrib = leaf_vals.reshape(len(self.trees), K, X.shape[0])
+            scores += self.shrinkage * contrib.sum(axis=0).T
         return scores
 
     def predict(self, X) -> np.ndarray:

@@ -225,16 +225,21 @@ def grow_tree(
         S = len(frontier)
         if S == 0:
             break
+        # pad the frontier to the next power of two: bounds the set of
+        # compiled histogram/split shapes to {1, 2, 4, ...} across all trees
+        S_pad = 1
+        while S_pad < S:
+            S_pad <<= 1
         if num_vars is None or num_vars >= F:
-            feat_ok = np.ones((S, F), bool)
+            feat_ok = np.ones((S_pad, F), bool)
         else:
-            feat_ok = np.zeros((S, F), bool)
+            feat_ok = np.zeros((S_pad, F), bool)
             for s in range(S):
                 feat_ok[s, rng.choice(F, size=num_vars, replace=False)] = True
         feat_okj = jnp.asarray(feat_ok)
 
         if classification:
-            hist = _hist_classification(Xb, yj, wj, assign, S, n_bins, n_classes)
+            hist = _hist_classification(Xb, yj, wj, assign, S_pad, n_bins, n_classes)
             gain, bf, bb, counts = _best_split_classification(
                 hist, nomj, feat_okj, rule, float(min_leaf))
             gain = np.asarray(gain)
@@ -243,7 +248,7 @@ def grow_tree(
             counts = np.asarray(counts)
             node_sizes = counts.sum(-1)
         else:
-            stats = _hist_regression(Xb, yj, wj, S, n_bins, assign)
+            stats = _hist_regression(Xb, yj, wj, S_pad, n_bins, assign)
             gain, bf, bb, cnts, means = _best_split_regression(
                 stats, nomj, feat_okj, float(min_leaf))
             gain = np.asarray(gain)
@@ -252,10 +257,11 @@ def grow_tree(
             node_sizes = np.asarray(cnts)
             means = np.asarray(means)
 
-        # decide splits on host (tiny); build next frontier
-        isleaf = np.ones(S, bool)
-        leftslot = np.full(S, -1, np.int32)
-        rightslot = np.full(S, -1, np.int32)
+        # decide splits on host (tiny); build next frontier (padded slots stay
+        # leaves so _update_assign keeps power-of-two shapes too)
+        isleaf = np.ones(S_pad, bool)
+        leftslot = np.full(S_pad, -1, np.int32)
+        rightslot = np.full(S_pad, -1, np.int32)
         next_frontier: List[int] = []
         for s, nid in enumerate(frontier):
             if classification:
@@ -285,13 +291,17 @@ def grow_tree(
 
         if not next_frontier:
             break
+        feat_arr = np.zeros(S_pad, np.int32)
+        thr_arr = np.zeros(S_pad, np.int32)
+        nom_arr = np.zeros(S_pad, bool)
+        for s, nid in enumerate(frontier):
+            feat_arr[s] = feature[nid] if feature[nid] >= 0 else 0
+            thr_arr[s] = thr[nid]
+            nom_arr[s] = nom[nid]
         assign = _update_assign(
-            Xb, assign,
-            jnp.asarray(np.array([feature[n] if feature[n] >= 0 else 0 for n in frontier],
-                                 np.int32)),
-            jnp.asarray(np.array([thr[n] for n in frontier], np.int32)),
-            jnp.asarray(np.array([nom[n] for n in frontier], bool)),
-            jnp.asarray(leftslot), jnp.asarray(rightslot), jnp.asarray(isleaf))
+            Xb, assign, jnp.asarray(feat_arr), jnp.asarray(thr_arr),
+            jnp.asarray(nom_arr), jnp.asarray(leftslot), jnp.asarray(rightslot),
+            jnp.asarray(isleaf))
         frontier = next_frontier
 
     M = len(feature)
@@ -312,6 +322,50 @@ def grow_tree(
         leaf_value=np.asarray(values, np.float32),
         n_nodes=M,
     )
+
+
+def stack_trees(trees) -> dict:
+    """Pad per-tree arrays to a common node count for vmapped prediction."""
+    M = max(t.n_nodes for t in trees)
+
+    def pad(a, fill):
+        out = np.full((len(trees), M), fill, dtype=a[0].dtype)
+        for i, x in enumerate(a):
+            out[i, : len(x)] = x
+        return out
+
+    return {
+        "feature": jnp.asarray(pad([t.feature for t in trees], -1)),
+        "thr": jnp.asarray(pad([t.threshold_bin for t in trees], 0)),
+        "nominal": jnp.asarray(pad([t.nominal for t in trees], False)),
+        "left": jnp.asarray(pad([t.left for t in trees], -1)),
+        "right": jnp.asarray(pad([t.right for t in trees], -1)),
+        "value": jnp.asarray(pad([t.leaf_value for t in trees], 0.0)),
+    }
+
+
+@jax.jit
+def predict_forest_binned(stacked: dict, Xb, max_depth: int = 64):
+    """All trees x all rows in one vmapped walk -> leaf values [T, N]."""
+    Xbj = jnp.asarray(Xb, jnp.int32)
+
+    def one_tree(feature, thr, nominal, left, right, value):
+        node = jnp.zeros((Xbj.shape[0],), jnp.int32)
+
+        def body(_, node):
+            f = feature[node]
+            leaf = f < 0
+            fz = jnp.maximum(f, 0)
+            b = jnp.take_along_axis(Xbj, fz[:, None], axis=1)[:, 0]
+            go_left = jnp.where(nominal[node], b == thr[node], b <= thr[node])
+            nxt = jnp.where(go_left, left[node], right[node])
+            return jnp.where(leaf, node, nxt)
+
+        node = jax.lax.fori_loop(0, max_depth, body, node)
+        return value[node]
+
+    return jax.vmap(one_tree)(stacked["feature"], stacked["thr"], stacked["nominal"],
+                              stacked["left"], stacked["right"], stacked["value"])
 
 
 def predict_binned(tree: TreeArrays, Xb: np.ndarray, max_depth: int = 64) -> np.ndarray:
