@@ -243,11 +243,14 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan"):
 
 def _ffm_scores(state: FFMState, hyper: FFMHyper, indices, values, fields):
     @jax.jit
-    def score(idx, val, fld):
-        p, _, _, _ = _row_predict(state, idx, val, fld, hyper)
-        return p
+    def score(st, idx, val, fld):
+        def one(i, v, f):
+            p, _, _, _ = _row_predict(st, i, v, f, hyper)
+            return p
 
-    return jax.vmap(score)(indices, values, fields)
+        return jax.vmap(one)(idx, val, fld)
+
+    return score(state, indices, values, fields)
 
 
 @dataclass

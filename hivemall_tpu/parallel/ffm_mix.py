@@ -1,0 +1,82 @@
+"""Data-parallel FFM training with collective mixing.
+
+Same contract as fm_mix.py: replicas train on shards, weights cross the
+"wire", optimizer state stays local. Mixable FFM state: w0 (pmean), w
+(touch-weighted average), V (plain pmean — the hashed (feature,field) table
+has no per-entry touch mask; entries untouched everywhere are identical
+across replicas so the mean is a no-op for them). FTRL z/n and AdaGrad gg
+stay device-local.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..models.ffm import FFMHyper, FFMState, init_ffm_state, make_ffm_step
+from .mesh import WORKER_AXIS, make_mesh
+
+
+class FFMMixTrainer:
+    def __init__(self, hyper: FFMHyper, mesh: Optional[Mesh] = None,
+                 mode: str = "minibatch", axis_name: str = WORKER_AXIS):
+        self.hyper = hyper
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.n_dev = self.mesh.devices.size
+        self.axis = axis_name
+        local_step = make_ffm_step(hyper, mode)
+
+        def device_step(state: FFMState, indices, values, fields, labels):
+            st = jax.tree.map(lambda x: x[0], state)
+            blocks = (indices[0], values[0], fields[0], labels[0])
+
+            def body(s, blk):
+                s, loss = local_step(s, *blk)
+                return s, loss
+
+            st, losses = jax.lax.scan(body, st, blocks)
+            counts = st.touched.astype(jnp.float32)
+            total = jax.lax.psum(counts, self.axis)
+            w = jnp.where(total > 0,
+                          jax.lax.psum(st.w * counts, self.axis)
+                          / jnp.maximum(total, 1.0), st.w)
+            st = st.replace(
+                w=w,
+                v=jax.lax.pmean(st.v, self.axis),
+                w0=jax.lax.pmean(st.w0, self.axis),
+            )
+            return jax.tree.map(lambda x: x[None], st), jax.lax.psum(
+                jnp.sum(losses), self.axis)
+
+        spec_state = jax.tree.map(lambda _: P(self.axis),
+                                  jax.eval_shape(lambda: init_ffm_state(hyper)))
+        self._step = jax.jit(
+            jax.shard_map(
+                device_step,
+                mesh=self.mesh,
+                in_specs=(spec_state,) + (P(self.axis),) * 4,
+                out_specs=(spec_state, P()),
+            ),
+            donate_argnums=(0,),
+        )
+
+    def init(self) -> FFMState:
+        one = init_ffm_state(self.hyper)
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[None], (self.n_dev,) + x.shape), one)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(
+                self.mesh, P(*((self.axis,) + (None,) * (x.ndim - 1))))), stacked)
+
+    def step(self, state, indices, values, fields, labels):
+        return self._step(state, indices, values, fields, labels)
+
+    def final_state(self, state) -> FFMState:
+        host = jax.device_get(state)
+        merged = jax.tree.map(lambda x: x[0], host)
+        return merged.replace(touched=np.max(np.asarray(host.touched), axis=0))

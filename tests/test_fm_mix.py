@@ -63,3 +63,39 @@ def test_fm_mix_trains_across_replicas():
     p = model.predict((idx, val))
     acc = float(np.mean(np.sign(p) == y))
     assert acc > 0.8, acc
+
+
+def test_ffm_mix_trains():
+    import sys
+    sys.path.insert(0, "tests")
+    from test_ffm import _gen_ffm_data
+
+    from hivemall_tpu.models.ffm import FFMHyper, TrainedFFMModel, _stage_ffm_rows
+    from hivemall_tpu.ops.eta import fixed as fixed_eta
+    from hivemall_tpu.parallel.ffm_mix import FFMMixTrainer
+
+    rows, y = _gen_ffm_data(n=1024)
+    hyper = FFMHyper(factors=4, num_features=1 << 18, v_dims=1 << 18,
+                     lambda_w=0.0, lambda_v=0.0, seed=1)
+    idx, val, fld, lab = _stage_ffm_rows(rows, y, hyper)
+    n_dev, B = 8, 32
+    n_blocks = len(rows) // B
+    k = n_blocks // n_dev
+    sh = lambda a: a.reshape((n_dev, k, B) + a.shape[2:]) if a.ndim > 2 else \
+        a.reshape((n_dev, k, B))
+    I = idx.reshape(n_blocks, B, -1)
+    V = val.reshape(n_blocks, B, -1)
+    F = fld.reshape(n_blocks, B, -1)
+    L = lab.reshape(n_blocks, B)
+    resh = lambda a: a.reshape((n_dev, k) + a.shape[1:])
+    trainer = FFMMixTrainer(hyper, make_mesh(n_dev))
+    state = trainer.init()
+    losses = []
+    for _ in range(10):
+        state, loss = trainer.step(state, resh(I), resh(V), resh(F), resh(L))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    final = trainer.final_state(state)
+    model = TrainedFFMModel(state=final, hyper=hyper)
+    acc = float(np.mean(np.sign(model.predict(rows)) == y))
+    assert acc > 0.75, acc
