@@ -1,0 +1,93 @@
+"""Microbenchmark the AROW minibatch step's components on the current device.
+
+Times (a) full step, (b) gather+math only, (c) each scatter variant, to find
+where the ~10ms/step goes (PERF.md optimization plan step 1).
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n * 1e3  # ms
+
+
+def main():
+    dims = 1 << 22
+    batch = 16384
+    width = 32
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray((rng.zipf(1.3, size=(batch, width)) % dims).astype(np.int32))
+    val = jnp.ones((batch, width), dtype=np.float32)
+    lab = jnp.asarray(np.sign(rng.randn(batch)).astype(np.float32))
+    w = jnp.zeros((dims,), jnp.float32)
+    cov = jnp.ones((dims,), jnp.float32)
+
+    @jax.jit
+    def gather_math(w, cov, idx, val, lab):
+        wg = w.at[idx].get(mode="fill", fill_value=0.0)
+        cg = cov.at[idx].get(mode="fill", fill_value=1.0)
+        score = jnp.sum(wg * val, axis=-1)
+        var = jnp.sum(cg * val * val, axis=-1)
+        m = lab * score
+        beta = 1.0 / (var + 0.1)
+        alpha = jnp.maximum(0.0, 1.0 - m) * beta
+        dw = (alpha * lab)[:, None] * cg * val
+        dcov = -(beta[:, None] * (cg * val) ** 2)
+        return dw, dcov
+
+    @jax.jit
+    def one_scatter(w, idx, dw):
+        return jnp.zeros_like(w).at[idx].add(dw, mode="drop")
+
+    @jax.jit
+    def scatter_into_2d(w, idx, dw, dcov, upd):
+        # fused: one scatter of [B,K,3] into [D,3]
+        acc = jnp.zeros((w.shape[0], 3), jnp.float32)
+        payload = jnp.stack([dw, dcov, upd], axis=-1)
+        return acc.at[idx].add(payload, mode="drop")
+
+    @jax.jit
+    def sort_segsum(w, idx, dw):
+        flat_i = idx.reshape(-1)
+        flat_d = dw.reshape(-1)
+        order = jnp.argsort(flat_i)
+        si = flat_i[order]
+        sd = flat_d[order]
+        return jnp.zeros_like(w).at[si].add(sd, mode="drop")
+
+    @jax.jit
+    def full_d_pass(w, dw_sum, counts):
+        return w + dw_sum / jnp.maximum(counts, 1.0)
+
+    dw, dcov = gather_math(w, cov, idx, val, lab)
+    upd = jnp.ones_like(dw)
+    print("gather+math      :", round(timeit(gather_math, w, cov, idx, val, lab), 3), "ms")
+    print("one scatter [D]  :", round(timeit(one_scatter, w, idx, dw), 3), "ms")
+    print("fused [D,3] scat :", round(timeit(scatter_into_2d, w, idx, dw, dcov, upd), 3), "ms")
+    print("sort+scatter     :", round(timeit(sort_segsum, w, idx, dw), 3), "ms")
+    dw_sum = one_scatter(w, idx, dw)
+    counts = one_scatter(w, idx, upd)
+    print("full-D pass      :", round(timeit(full_d_pass, w, dw_sum, counts), 3), "ms")
+
+    # int8 touched scatter-max
+    touched = jnp.zeros((dims,), jnp.int8)
+
+    @jax.jit
+    def touch_max(t, idx, lane):
+        return t.at[idx].max(lane, mode="drop")
+
+    lane = jnp.ones_like(idx, jnp.int8)
+    print("touched max int8 :", round(timeit(touch_max, touched, idx, lane), 3), "ms")
+
+
+if __name__ == "__main__":
+    main()
