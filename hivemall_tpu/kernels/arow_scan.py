@@ -2,83 +2,43 @@
 
 The engine's scan mode (core/engine.py) is reference-exact but pays an
 HBM-roundtrip gather/scatter per ROW. For models that fit on-chip
-(dims <= ~2^20 f32: weights + covariance = 8MB of ~16MB VMEM), this kernel
-keeps BOTH tables resident in VMEM and replays the whole block's rows
-sequentially in-kernel — the reference's per-row semantics
-(ref: classifier/AROWClassifierUDTF.java:95-148) at on-chip latency.
+(dims <= ~2^20 f32: weights + covariance = 8MB of ~16MB VMEM), the generic
+VMEM-resident scan backend (kernels/linear_scan.py) keeps BOTH tables
+resident and replays the whole block's rows sequentially in-kernel — the
+reference's per-row semantics (ref: classifier/AROWClassifierUDTF.java:95-148)
+at on-chip latency.
+
+This module keeps the dedicated AROW entry point as a thin wrapper over that
+backend (they were separate implementations before the backend's table
+layout was reworked to lower on real TPU Mosaic — scalar VMEM stores, which
+the original kernels used, do not compile on hardware).
 
 Padding protocol matches core/batch.py (pad index == dims); padded lanes are
-masked in-kernel. Validated bit-for-bit against the engine's scan mode in
-interpret mode (tests/test_pallas_kernels.py); on real TPU it is opt-in via
-`use_pallas=True` until hardware profiles pick the default (PERF.md).
+masked in-kernel. Validated against the engine's scan mode both in interpret
+mode and compiled on a real TPU chip (tests/test_pallas_kernels.py,
+scripts/pallas_tpu_check.py).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-
-
-def _arow_kernel(K: int, r: float, idx_ref, val_ref, y_ref, w_ref, cov_ref,
-                 w_out, cov_out, loss_out):
-    B = idx_ref.shape[0]
-    D = w_ref.shape[0]
-    w_out[:] = w_ref[:]
-    cov_out[:] = cov_ref[:]
-
-    def row(b, _):
-        y = y_ref[b]
-        # gather lanes (K static; sequential like the reference's feature loop)
-        score = jnp.float32(0.0)
-        var = jnp.float32(0.0)
-        for k in range(K):
-            i = idx_ref[b, k]
-            x = val_ref[b, k]
-            safe = jnp.minimum(i, D - 1)
-            w = w_out[safe]
-            cv = cov_out[safe]
-            score = score + w * x
-            var = var + cv * x * x
-        m = score * y
-        beta = 1.0 / (var + r)
-        alpha = (1.0 - m) * beta
-        upd = (m < 1.0).astype(jnp.float32)
-        for k in range(K):
-            i = idx_ref[b, k]
-            x = val_ref[b, k]
-            safe = jnp.minimum(i, D - 1)
-            live = jnp.logical_and(i < D, x != 0.0).astype(jnp.float32) * upd
-            cv = cov_out[safe] * x
-            w_old = w_out[safe]
-            c_old = cov_out[safe]
-            w_out[safe] = w_old + live * (y * alpha * cv)
-            cov_out[safe] = c_old - live * (beta * cv * cv)
-        loss_out[b] = jnp.where(m < 0.0, 1.0, 0.0)
-        return 0
-
-    jax.lax.fori_loop(0, B, row, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("r", "interpret"))
 def arow_scan_block(indices, values, labels, weights, covars, r: float = 0.1,
                     interpret: bool = False):
     """Run one block of rows sequentially; returns (weights, covars, losses)."""
-    from jax.experimental import pallas as pl
+    from ..core.state import init_linear_state
+    from ..models.classifier import AROW
+    from .linear_scan import pallas_scan_raw
 
-    B, K = indices.shape
-    D = weights.shape[0]
-    kernel = functools.partial(_arow_kernel, K, r)
-    w, cov, loss = pl.pallas_call(
-        kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct((D,), jnp.float32),
-            jax.ShapeDtypeStruct((D,), jnp.float32),
-            jax.ShapeDtypeStruct((B,), jnp.float32),
-        ),
-        interpret=interpret,
-    )(indices, values, labels, weights, covars)
-    return w, cov, loss
+    d = weights.shape[0]
+    state = init_linear_state(d, use_covariance=True,
+                              initial_weights=jnp.asarray(weights, jnp.float32),
+                              initial_covars=jnp.asarray(covars, jnp.float32))
+    new_state, losses = pallas_scan_raw(AROW, {"r": r}, state, indices,
+                                        values, labels, interpret=interpret)
+    return new_state.weights, new_state.covars, losses

@@ -1,0 +1,37 @@
+"""Shared case definitions for Pallas scan-backend validation, used by both
+the interpret-mode tests (tests/test_pallas_kernels.py) and the on-hardware
+check (scripts/pallas_tpu_check.py) so the two can't drift apart."""
+
+import numpy as np
+
+
+def make_block_data(B=64, K=8, D=256, seed=0):
+    rng = np.random.RandomState(seed)
+    idx = np.stack([rng.choice(D, size=K, replace=False)
+                    for _ in range(B)]).astype(np.int32)
+    val = rng.randn(B, K).astype(np.float32)
+    # pad some lanes like the block format does
+    for b in range(0, B, 3):
+        idx[b, -2:] = D
+        val[b, -2:] = 0.0
+    y = np.sign(rng.randn(B)).astype(np.float32)
+    return idx, val, y
+
+
+def generic_rules():
+    """(rule, hyper, is_binary) covering every engine feature class: plain
+    additive, PA, covariance, SCW closed forms, dual averaging (derive_w +
+    slots), regression with Welford globals, AdaGrad slots."""
+    from hivemall_tpu.models import classifier as C
+    from hivemall_tpu.models import regression as R
+
+    return [
+        (C.PERCEPTRON, {}, True),
+        (C.PA1, {"c": 1.0}, True),
+        (C.AROW, {"r": 0.1}, True),
+        (C.SCW1, {"phi": 1.0, "c": 1.0}, True),
+        (C.ADAGRAD_RDA, {"eta": 0.1, "lambda": 1e-6, "scale": 100.0}, True),
+        (R.AROW_REGR, {"r": 0.1}, False),
+        (R.PA1A_REGR, {"c": 1.0, "epsilon": 0.01}, False),
+        (R.ADAGRAD_REGR, {"eta": 1.0, "eps": 1.0, "scale": 100.0}, False),
+    ]

@@ -10,16 +10,9 @@ from hivemall_tpu.kernels.arow_scan import arow_scan_block
 from hivemall_tpu.models.classifier import AROW
 
 
-def _data(B=64, K=8, D=256, seed=0):
-    rng = np.random.RandomState(seed)
-    idx = np.stack([rng.choice(D, size=K, replace=False) for _ in range(B)]).astype(np.int32)
-    val = rng.randn(B, K).astype(np.float32)
-    # pad some lanes like the block format does
-    for b in range(0, B, 3):
-        idx[b, -2:] = D
-        val[b, -2:] = 0.0
-    y = np.sign(rng.randn(B)).astype(np.float32)
-    return idx, val, y
+from pallas_cases import generic_rules, make_block_data
+
+_data = make_block_data
 
 
 def test_arow_pallas_matches_engine_scan():
@@ -59,23 +52,7 @@ def test_arow_pallas_sequential_dependence():
     np.testing.assert_allclose(np.asarray(w), np.asarray(ref.weights), rtol=1e-5)
 
 
-RULES_FOR_GENERIC = None
-
-
-def _generic_rules():
-    from hivemall_tpu.models import classifier as C
-    from hivemall_tpu.models import regression as R
-
-    return [
-        (C.PERCEPTRON, {}, True),
-        (C.PA1, {"c": 1.0}, True),
-        (C.AROW, {"r": 0.1}, True),
-        (C.SCW1, {"phi": 1.0, "c": 1.0}, True),
-        (C.ADAGRAD_RDA, {"eta": 0.1, "lambda": 1e-6, "scale": 100.0}, True),
-        (R.AROW_REGR, {"r": 0.1}, False),
-        (R.PA1A_REGR, {"c": 1.0, "epsilon": 0.01}, False),
-        (R.ADAGRAD_REGR, {"eta": 1.0, "eps": 1.0, "scale": 100.0}, False),
-    ]
+_generic_rules = generic_rules
 
 
 @pytest.mark.parametrize("i", range(8))
