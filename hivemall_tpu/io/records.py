@@ -32,31 +32,39 @@ def write_records(prefix: str, idx_rows: Sequence[np.ndarray],
                   val_rows: Sequence[np.ndarray], labels: Sequence[float],
                   num_shards: int = 1) -> List[str]:
     """Round-robin rows into `num_shards` files `prefix-{i:05d}.hmtr`."""
+    from .. import native
+
     paths = [f"{prefix}-{i:05d}.hmtr" for i in range(num_shards)]
-    bufs: List[bytearray] = [bytearray() for _ in range(num_shards)]
-    counts = [0] * num_shards
-    for r, (idx, val) in enumerate(zip(idx_rows, val_rows)):
-        s = r % num_shards
-        out = bufs[s]
-        idx = np.asarray(idx, np.int64)
-        order = np.argsort(idx)
-        idx = idx[order]
-        val = np.asarray(val, np.float32)[order]
-        if len(idx) > 255:
-            raise ValueError("row nnz > 255 unsupported by record format")
-        out.append(len(idx))
-        prev = 0
-        for i in idx:
-            leb128_encode(int(i) - prev, out)
-            prev = int(i)
-        out.extend(val.tobytes())
-        out.extend(struct.pack("<f", float(labels[r])))
-        counts[s] += 1
-    for p, buf, c in zip(paths, bufs, counts):
+    shard_rows: List[List[int]] = [list(range(s, len(idx_rows), num_shards))
+                                   for s in range(num_shards)]
+    for p, rows in zip(paths, shard_rows):
+        body = None
+        if native.available():
+            body = native.encode_records(
+                [np.asarray(idx_rows[r], np.int64) for r in rows],
+                [np.asarray(val_rows[r], np.float32) for r in rows],
+                np.asarray([labels[r] for r in rows], np.float32))
+        if body is None:
+            out = bytearray()
+            for r in rows:
+                idx = np.asarray(idx_rows[r], np.int64)
+                order = np.argsort(idx)
+                idx = idx[order]
+                val = np.asarray(val_rows[r], np.float32)[order]
+                if len(idx) > 255:
+                    raise ValueError("row nnz > 255 unsupported by record format")
+                out.append(len(idx))
+                prev = 0
+                for i in idx:
+                    leb128_encode(int(i) - prev, out)
+                    prev = int(i)
+                out.extend(val.tobytes())
+                out.extend(struct.pack("<f", float(labels[r])))
+            body = bytes(out)
         with open(p, "wb") as f:
             f.write(MAGIC)
-            f.write(struct.pack("<Q", c))
-            f.write(bytes(buf))
+            f.write(struct.pack("<Q", len(rows)))
+            f.write(body)
     return paths
 
 

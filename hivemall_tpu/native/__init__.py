@@ -42,6 +42,21 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
     ]
+    lib.hm_encode_records_bound.restype = ctypes.c_int64
+    lib.hm_encode_records_bound.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.hm_encode_records.restype = ctypes.c_int64
+    lib.hm_encode_records.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.hm_zigzag_leb128_encode.restype = ctypes.c_int64
+    lib.hm_zigzag_leb128_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+    ]
+    lib.hm_zigzag_leb128_decode.restype = ctypes.c_int64
+    lib.hm_zigzag_leb128_decode.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+    ]
     _lib = lib
     return lib
 
@@ -100,6 +115,71 @@ def decode_records(body: bytes, n_rows: int):
     if out != total:
         raise ValueError("corrupt record shard")
     return offsets, indices, values, labels
+
+
+def encode_records(idx_rows: Sequence[np.ndarray],
+                   val_rows: Sequence[np.ndarray],
+                   labels: np.ndarray) -> Optional[bytes]:
+    """Encode rows to an HMTR1 shard body (sorting each row by feature id),
+    or None without the library. Raises on nnz > 255 / negative ids."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(idx_rows)
+    if len(val_rows) != n or len(labels) != n:
+        raise ValueError("idx_rows/val_rows/labels length mismatch")
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, r in enumerate(idx_rows):
+        if len(val_rows[i]) != len(r):
+            raise ValueError(f"row {i}: {len(r)} indices vs "
+                             f"{len(val_rows[i])} values")
+        offsets[i + 1] = offsets[i] + len(r)
+    indices = (np.concatenate(idx_rows).astype(np.int64) if n else
+               np.zeros(0, np.int64))
+    values = (np.concatenate(val_rows).astype(np.float32) if n else
+              np.zeros(0, np.float32))
+    labs = np.ascontiguousarray(labels, dtype=np.float32)
+    cap = int(lib.hm_encode_records_bound(
+        offsets.ctypes.data_as(ctypes.c_void_p), n))
+    out = np.empty(max(cap, 1), dtype=np.uint8)
+    written = lib.hm_encode_records(
+        indices.ctypes.data_as(ctypes.c_void_p),
+        values.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        labs.ctypes.data_as(ctypes.c_void_p), n,
+        out.ctypes.data_as(ctypes.c_void_p), cap)
+    if written < 0:
+        raise ValueError("row nnz > 255 or negative feature id")
+    return out[:written].tobytes()
+
+
+def zigzag_leb128_encode(values: np.ndarray) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(values, dtype=np.int64)
+    cap = 10 * len(vals)
+    out = np.empty(max(cap, 1), dtype=np.uint8)
+    written = lib.hm_zigzag_leb128_encode(
+        vals.ctypes.data_as(ctypes.c_void_p), len(vals),
+        out.ctypes.data_as(ctypes.c_void_p), cap)
+    if written < 0:
+        raise ValueError("zigzag-leb128 encode overflow")
+    return out[:written].tobytes()
+
+
+def zigzag_leb128_decode(buf: bytes, n: int) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    data = np.frombuffer(buf, dtype=np.uint8)
+    out = np.empty(max(n, 1), dtype=np.int64)
+    consumed = lib.hm_zigzag_leb128_decode(
+        data.ctypes.data_as(ctypes.c_void_p), len(data), n,
+        out.ctypes.data_as(ctypes.c_void_p))
+    if consumed < 0:
+        raise ValueError("corrupt zigzag-leb128 stream")
+    return out[:n]
 
 
 def pack_block(idx_rows: Sequence[np.ndarray], val_rows: Sequence[np.ndarray],

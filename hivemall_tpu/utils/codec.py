@@ -42,8 +42,10 @@ def bits_to_half_float(bits: int) -> float:
 # ---------------------------------------------------------------- zigzag
 
 def zigzag_encode(v: int) -> int:
-    """Signed -> unsigned zigzag (ref: ZigZagLEB128Codec.java)."""
-    return (v << 1) ^ (v >> 63) if v < 0 else v << 1
+    """Signed -> unsigned zigzag (ref: ZigZagLEB128Codec.java). The Java
+    codec's (v << 1) ^ (v >> 63) form assumes 64-bit wrap; on unbounded
+    Python ints the equivalent is the closed form below."""
+    return (-v << 1) - 1 if v < 0 else v << 1
 
 
 def zigzag_decode(v: int) -> int:
@@ -79,13 +81,36 @@ def leb128_decode(buf: bytes, pos: int = 0) -> Tuple[int, int]:
 
 
 def zigzag_leb128_encode_array(values: Iterable[int]) -> bytes:
+    vals = values if isinstance(values, np.ndarray) else list(values)
+    arr = None
+    if not (isinstance(vals, np.ndarray)
+            and not np.can_cast(vals.dtype, np.int64, "safe")):
+        try:
+            arr = np.asarray(vals, np.int64)
+        except (OverflowError, ValueError):  # >64-bit: Python path only
+            arr = None
+    if arr is not None and arr.size:
+        from .. import native
+
+        encoded = native.zigzag_leb128_encode(arr)
+        if encoded is not None:
+            return encoded
     out = bytearray()
-    for v in values:
+    for v in vals:
         leb128_encode(zigzag_encode(int(v)), out)
     return bytes(out)
 
 
 def zigzag_leb128_decode_array(buf: bytes, n: int) -> List[int]:
+    from .. import native
+
+    if n:
+        try:
+            decoded = native.zigzag_leb128_decode(buf, n)
+        except ValueError:  # >64-bit values: only the Python path handles them
+            decoded = None
+        if decoded is not None:
+            return decoded.tolist()
     out = []
     pos = 0
     for _ in range(n):
@@ -134,7 +159,7 @@ def encode_sparse_model(feats: np.ndarray, weights: np.ndarray,
     feats = feats[order]
     weights = np.asarray(weights, np.float32)[order]
     deltas = np.diff(feats, prepend=0)
-    idx_bytes = zigzag_leb128_encode_array(deltas.tolist())
+    idx_bytes = zigzag_leb128_encode_array(deltas)
     if half_float:
         w_bytes = float_to_half(weights).tobytes()
     else:

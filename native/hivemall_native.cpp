@@ -9,9 +9,11 @@
 // Exposed as a plain C ABI consumed via ctypes (hivemall_tpu/native/__init__.py).
 // Build: scripts/build_native.sh (cmake or direct g++).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
+#include <vector>
 
 extern "C" {
 
@@ -131,7 +133,7 @@ int64_t hm_decode_records(const uint8_t* data, int64_t len, int64_t n_rows,
             int64_t v = 0;
             int shift = 0;
             while (true) {
-                if (pos >= len) return -1;
+                if (pos >= len || shift > 63) return -1;
                 const uint8_t b = data[pos++];
                 v |= static_cast<int64_t>(b & 0x7F) << shift;
                 if (!(b & 0x80)) break;
@@ -149,6 +151,108 @@ int64_t hm_decode_records(const uint8_t* data, int64_t len, int64_t n_rows,
     }
     if (row_offsets) row_offsets[n_rows] = total;
     return total;
+}
+
+// Encode rows into an HMTR1 shard body (the write side of hm_decode_records;
+// hivemall_tpu/io/records.py format). Rows are concatenated in
+// `indices`/`values` with `offsets[n_rows+1]`; each row is sorted by feature
+// id here so ids delta-code monotonically. Returns bytes written, or -1 when
+// a row exceeds 255 nnz / ids are negative / `cap` is too small (size the
+// buffer with hm_encode_records_bound).
+int64_t hm_encode_records_bound(const int64_t* offsets, int64_t n_rows) {
+    // worst case per row: 1 (nnz) + 10 (varint) * nnz + 4 * nnz + 4 (label)
+    const int64_t total_nnz = offsets[n_rows];
+    return n_rows * 5 + total_nnz * 14;
+}
+
+int64_t hm_encode_records(const int64_t* indices, const float* values,
+                          const int64_t* offsets, const float* labels,
+                          int64_t n_rows, uint8_t* out, int64_t cap) {
+    int64_t pos = 0;
+    std::vector<std::pair<int64_t, float>> row;
+    for (int64_t r = 0; r < n_rows; r++) {
+        const int64_t start = offsets[r];
+        const int64_t nnz = offsets[r + 1] - start;
+        if (nnz > 255) return -1;
+        row.clear();
+        for (int64_t k = 0; k < nnz; k++) {
+            if (indices[start + k] < 0) return -1;
+            row.emplace_back(indices[start + k], values[start + k]);
+        }
+        std::sort(row.begin(), row.end());
+        if (pos + 1 + nnz * 14 + 4 > cap) return -1;
+        out[pos++] = static_cast<uint8_t>(nnz);
+        int64_t prev = 0;
+        for (int64_t k = 0; k < nnz; k++) {
+            uint64_t d = static_cast<uint64_t>(row[k].first - prev);
+            prev = row[k].first;
+            while (true) {
+                const uint8_t b = d & 0x7F;
+                d >>= 7;
+                if (d) {
+                    out[pos++] = b | 0x80;
+                } else {
+                    out[pos++] = b;
+                    break;
+                }
+            }
+        }
+        for (int64_t k = 0; k < nnz; k++) {
+            std::memcpy(out + pos, &row[k].second, 4);
+            pos += 4;
+        }
+        std::memcpy(out + pos, labels + r, 4);
+        pos += 4;
+    }
+    return pos;
+}
+
+// ------------------------------------------------------------ zigzag-LEB128
+
+// Bulk signed-int codec (ref: utils/codec/ZigZagLEB128Codec.java) — the model
+// blob compression hot path (encode_sparse_model delta streams). Returns
+// bytes written (encode) / bytes consumed (decode), or -1 on overflow/corrupt.
+int64_t hm_zigzag_leb128_encode(const int64_t* vals, int64_t n, uint8_t* out,
+                                int64_t cap) {
+    int64_t pos = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t u = (static_cast<uint64_t>(vals[i]) << 1) ^
+                     static_cast<uint64_t>(vals[i] >> 63);
+        if (pos + 10 > cap) return -1;
+        while (true) {
+            const uint8_t b = u & 0x7F;
+            u >>= 7;
+            if (u) {
+                out[pos++] = b | 0x80;
+            } else {
+                out[pos++] = b;
+                break;
+            }
+        }
+    }
+    return pos;
+}
+
+int64_t hm_zigzag_leb128_decode(const uint8_t* buf, int64_t len, int64_t n,
+                                int64_t* out) {
+    int64_t pos = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t u = 0;
+        int shift = 0;
+        while (true) {
+            if (pos >= len || shift > 63) return -1;
+            const uint8_t b = buf[pos++];
+            // at shift 63 only bit 0 of the payload fits in 64 bits; a wider
+            // final byte means the stream encodes a >64-bit value (the Python
+            // big-int path owns those) — reject rather than silently wrap
+            if (shift == 63 && (b & 0x7E)) return -1;
+            u |= static_cast<uint64_t>(b & 0x7F) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        out[i] = static_cast<int64_t>(u >> 1) ^ -static_cast<int64_t>(u & 1);
+    }
+    return pos;
 }
 
 // Parse a "idx:value" / "idx" feature byte-string (int features) without

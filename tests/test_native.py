@@ -42,3 +42,119 @@ def test_pack_block_parity():
         np.testing.assert_array_equal(blk.indices[i, :k], r % 1024)
         assert np.all(blk.indices[i, k:] == 1024)
         assert np.all(blk.values[i, k:] == 0.0)
+
+
+def _python_encode_shard_body(idx_rows, val_rows, labels):
+    """The pre-native write_records row codec, kept as the parity oracle."""
+    import struct
+
+    from hivemall_tpu.utils.codec import leb128_encode
+
+    out = bytearray()
+    for idx, val, lab in zip(idx_rows, val_rows, labels):
+        idx = np.asarray(idx, np.int64)
+        order = np.argsort(idx)
+        idx = idx[order]
+        val = np.asarray(val, np.float32)[order]
+        out.append(len(idx))
+        prev = 0
+        for i in idx:
+            leb128_encode(int(i) - prev, out)
+            prev = int(i)
+        out.extend(val.tobytes())
+        out.extend(struct.pack("<f", float(lab)))
+    return bytes(out)
+
+
+def test_encode_records_parity_and_roundtrip():
+    rng = np.random.RandomState(7)
+    idx_rows = [np.unique(rng.randint(0, 1 << 22, size=rng.randint(1, 40)))
+                for _ in range(200)]
+    val_rows = [rng.randn(len(r)).astype(np.float32) for r in idx_rows]
+    labels = rng.randn(200).astype(np.float32)
+    body = native.encode_records(idx_rows, val_rows, labels)
+    assert body == _python_encode_shard_body(idx_rows, val_rows, labels)
+    # decoder round-trip
+    offsets, indices, values, labs = native.decode_records(body, 200)
+    np.testing.assert_array_equal(labs, labels)
+    for r in range(200):
+        got = indices[offsets[r]:offsets[r + 1]]
+        np.testing.assert_array_equal(got, idx_rows[r])
+        np.testing.assert_array_equal(values[offsets[r]:offsets[r + 1]],
+                                      val_rows[r])
+
+
+def test_encode_records_sorts_unsorted_rows():
+    idx = [np.array([50, 3, 17], np.int64)]
+    val = [np.array([5.0, 3.0, 1.7], np.float32)]
+    body = native.encode_records(idx, val, np.array([1.0], np.float32))
+    offsets, indices, values, _ = native.decode_records(body, 1)
+    np.testing.assert_array_equal(indices, [3, 17, 50])
+    np.testing.assert_array_equal(values, np.array([3.0, 1.7, 5.0], np.float32))
+
+
+def test_encode_records_rejects_wide_rows():
+    idx = [np.arange(300, dtype=np.int64)]
+    val = [np.ones(300, np.float32)]
+    with pytest.raises(ValueError):
+        native.encode_records(idx, val, np.array([0.0], np.float32))
+
+
+def test_zigzag_leb128_native_parity():
+    from hivemall_tpu.utils.codec import (leb128_encode, zigzag_decode,
+                                          zigzag_encode)
+
+    rng = np.random.RandomState(11)
+    vals = np.concatenate([
+        rng.randint(-1000, 1000, size=500),
+        rng.randint(np.iinfo(np.int64).min, np.iinfo(np.int64).max, size=100),
+        np.array([0, -1, 1, np.iinfo(np.int64).min, np.iinfo(np.int64).max]),
+    ]).astype(np.int64)
+    expected = bytearray()
+    for v in vals:
+        leb128_encode(zigzag_encode(int(v)), expected)
+    enc = native.zigzag_leb128_encode(vals)
+    assert enc == bytes(expected)
+    dec = native.zigzag_leb128_decode(enc, len(vals))
+    np.testing.assert_array_equal(dec, vals)
+    # python decode of the same stream agrees
+    out, pos = [], 0
+    from hivemall_tpu.utils.codec import leb128_decode
+    for _ in range(len(vals)):
+        u, pos = leb128_decode(enc, pos)
+        out.append(zigzag_decode(u))
+    np.testing.assert_array_equal(np.asarray(out, np.int64), vals)
+
+
+def test_zigzag_leb128_big_int_falls_back_to_python():
+    # zigzag payloads in [2^64, 2^70) fit in exactly 10 LEB128 bytes; the
+    # native decoder must reject them (not wrap) so the big-int Python path
+    # decodes them instead.
+    from hivemall_tpu.utils.codec import (zigzag_leb128_decode_array,
+                                          zigzag_leb128_encode_array)
+
+    for v in [2**63, -(2**63) - 1, 2**69 - 1, -(2**69)]:
+        enc = zigzag_leb128_encode_array([v])
+        with pytest.raises(ValueError):
+            native.zigzag_leb128_decode(enc, 1)
+        assert zigzag_leb128_decode_array(enc, 1) == [v]
+
+
+def test_encode_records_rejects_length_mismatch():
+    with pytest.raises(ValueError):
+        native.encode_records([np.arange(5, dtype=np.int64)],
+                              [np.ones(3, np.float32)],
+                              np.array([0.0], np.float32))
+    with pytest.raises(ValueError):
+        native.encode_records([np.arange(3, dtype=np.int64)],
+                              [np.ones(3, np.float32)],
+                              np.array([], np.float32))
+
+
+def test_zigzag_leb128_uint64_array_uses_python_path():
+    from hivemall_tpu.utils.codec import (zigzag_leb128_decode_array,
+                                          zigzag_leb128_encode_array)
+
+    v = np.array([2**63 + 5], dtype=np.uint64)
+    enc = zigzag_leb128_encode_array(v)
+    assert zigzag_leb128_decode_array(enc, 1) == [2**63 + 5]
