@@ -41,14 +41,15 @@ def write_records(prefix: str, idx_rows: Sequence[np.ndarray],
         body = None
         if native.available():
             body = native.encode_records(
-                [np.asarray(idx_rows[r], np.int64) for r in rows],
-                [np.asarray(val_rows[r], np.float32) for r in rows],
+                [idx_rows[r] for r in rows], [val_rows[r] for r in rows],
                 np.asarray([labels[r] for r in rows], np.float32))
         if body is None:
             out = bytearray()
             for r in rows:
                 idx = np.asarray(idx_rows[r], np.int64)
-                order = np.argsort(idx)
+                # stable: equal-id entries keep input order (matches the
+                # native encoder's stable_sort byte-for-byte)
+                order = np.argsort(idx, kind="stable")
                 idx = idx[order]
                 val = np.asarray(val_rows[r], np.float32)[order]
                 if len(idx) > 255:

@@ -28,7 +28,8 @@ import numpy as np
 from ...utils.options import Options
 from .binning import BinInfo, MAX_BINS, bin_data, make_bins
 from .export import to_javascript, to_json, to_opscode
-from .grow import TreeArrays, grow_tree, predict_binned
+from .grow import (TreeArrays, grow_forest, grow_tree, predict_binned,
+                   predict_forest_binned, stack_trees)
 
 
 def _forest_options(gbt: bool = False) -> Options:
@@ -126,8 +127,11 @@ class TrainedForest:
 
 
 def _var_importance(tree: TreeArrays, F: int) -> np.ndarray:
-    """Split-count importance per feature (the reference accumulates impurity
-    gain; split counts are the compressed analog available post-hoc)."""
+    """Accumulated impurity-gain importance recorded during growth (what the
+    reference accumulates per split); split-count fallback for trees loaded
+    without it."""
+    if tree.importance is not None:
+        return tree.importance
     imp = np.zeros(F)
     for i in range(tree.n_nodes):
         if tree.feature[i] >= 0:
@@ -162,28 +166,33 @@ def train_randomforest_classifier(X, labels, options: Optional[str] = None
     num_vars = _num_vars(cl.get_float("vars") if cl.has("vars") else None, F)
     nominal_mask = np.array([a == "C" for a in attrs])
 
+    # bootstrap bag per tree (ref: :362-425 TrainingTask), then grow the WHOLE
+    # forest level-synchronously — one device histogram pass per level covers
+    # every tree (grow.grow_forest), replacing the reference's per-tree
+    # thread-pool with batched kernels
+    T = cl.get_int("trees", 50)
+    W = np.stack([
+        np.bincount(rng.randint(0, N, size=N), minlength=N).astype(np.float32)
+        for _ in range(T)])
+    tree_rngs = [np.random.RandomState(rng.randint(0, 2 ** 31)) for _ in range(T)]
+    grown = grow_forest(
+        Xb, y_idx, W, nominal_mask, n_bins,
+        classification=True, n_classes=n_classes, rule=rule,
+        max_depth=cl.get_int("depth", 16),
+        min_split=cl.get_int("splits", 2),
+        min_leaf=cl.get_int("min_samples_leaf", 1),
+        max_leaf_nodes=cl.get_int("leafs", 512),
+        num_vars=num_vars, rngs=tree_rngs,
+    )
+    # OOB error for all trees in one vmapped walk (ref: :330-341)
+    leaf_vals = np.asarray(predict_forest_binned(stack_trees(grown), Xb))  # [T, N]
     trees: List[TreeModel] = []
-    for t in range(cl.get_int("trees", 50)):
-        # bootstrap bag (ref: :362-425 TrainingTask)
-        counts = np.bincount(rng.randint(0, N, size=N), minlength=N).astype(np.float32)
-        tree = grow_tree(
-            Xb, y_idx, counts, nominal_mask, n_bins,
-            classification=True, n_classes=n_classes, rule=rule,
-            max_depth=cl.get_int("depth", 16),
-            min_split=cl.get_int("splits", 2),
-            min_leaf=cl.get_int("min_samples_leaf", 1),
-            max_leaf_nodes=cl.get_int("leafs", 512),
-            num_vars=num_vars, rng=rng,
-        )
-        # OOB error (ref: :330-341)
-        oob = counts == 0
+    output = str(cl.get("output", "opscode"))
+    for t, tree in enumerate(grown):
+        oob = W[t] == 0
         oob_tests = int(oob.sum())
-        oob_errors = 0
-        if oob_tests:
-            leaf = predict_binned(tree, Xb[oob])
-            pred = tree.leaf_value[leaf].astype(int)
-            oob_errors = int(np.sum(pred != y_idx[oob]))
-        mtype, model = _export(tree, bins, str(cl.get("output", "opscode")))
+        oob_errors = int(np.sum(leaf_vals[t, oob].astype(int) != y_idx[oob]))
+        mtype, model = _export(tree, bins, output)
         trees.append(TreeModel(t, mtype, model, _var_importance(tree, F),
                                oob_errors, oob_tests, tree, bins))
     return TrainedForest(trees, True, n_classes, bins, attrs)
@@ -204,25 +213,28 @@ def train_randomforest_regr(X, targets, options: Optional[str] = None
     num_vars = _num_vars(cl.get_float("vars") if cl.has("vars") else None, F)
     nominal_mask = np.array([a == "C" for a in attrs])
 
+    T = cl.get_int("trees", 50)
+    W = np.stack([
+        np.bincount(rng.randint(0, N, size=N), minlength=N).astype(np.float32)
+        for _ in range(T)])
+    tree_rngs = [np.random.RandomState(rng.randint(0, 2 ** 31)) for _ in range(T)]
+    grown = grow_forest(
+        Xb, y, W, nominal_mask, n_bins,
+        classification=False,
+        max_depth=cl.get_int("depth", 16),
+        min_split=cl.get_int("splits", 2),
+        min_leaf=cl.get_int("min_samples_leaf", 1),
+        max_leaf_nodes=cl.get_int("leafs", 512),
+        num_vars=num_vars, rngs=tree_rngs,
+    )
+    leaf_vals = np.asarray(predict_forest_binned(stack_trees(grown), Xb))  # [T, N]
     trees: List[TreeModel] = []
-    for t in range(cl.get_int("trees", 50)):
-        counts = np.bincount(rng.randint(0, N, size=N), minlength=N).astype(np.float32)
-        tree = grow_tree(
-            Xb, y, counts, nominal_mask, n_bins,
-            classification=False,
-            max_depth=cl.get_int("depth", 16),
-            min_split=cl.get_int("splits", 2),
-            min_leaf=cl.get_int("min_samples_leaf", 1),
-            max_leaf_nodes=cl.get_int("leafs", 512),
-            num_vars=num_vars, rng=rng,
-        )
-        oob = counts == 0
+    output = str(cl.get("output", "opscode"))
+    for t, tree in enumerate(grown):
+        oob = W[t] == 0
         oob_tests = int(oob.sum())
-        oob_err = 0.0
-        if oob_tests:
-            leaf = predict_binned(tree, Xb[oob])
-            oob_err = float(np.sum((tree.leaf_value[leaf] - y[oob]) ** 2))
-        mtype, model = _export(tree, bins, str(cl.get("output", "opscode")))
+        oob_err = float(np.sum((leaf_vals[t, oob] - y[oob]) ** 2))
+        mtype, model = _export(tree, bins, output)
         trees.append(TreeModel(t, mtype, model, _var_importance(tree, F),
                                int(oob_err), oob_tests, tree, bins))
     return TrainedForest(trees, False, 0, bins, attrs)
@@ -307,20 +319,28 @@ def train_gradient_tree_boosting_classifier(X, labels, options: Optional[str] = 
             rounds.append([tree])
         return TrainedGBT(rounds, intercept, eta, classes, bins)
 
-    # multiclass softmax
+    # multiclass softmax: the K class-trees of a round share the subsample
+    # mask but fit different residuals — grown as ONE batched forest pass
+    # via grow_forest's per-tree targets
     intercept = np.zeros(K)
     Fx = np.zeros((N, K))
     Y = np.eye(K)[y_idx]
     for _ in range(n_trees):
         e = np.exp(Fx - Fx.max(axis=1, keepdims=True))
         P = e / e.sum(axis=1, keepdims=True)
-        round_trees = []
         mask = rng.rand(N) < subsample
-        for k in range(K):
-            response = Y[:, k] - P[:, k]
-            tree = fit_residual_tree(response, mask)
-            leaf = predict_binned(tree, Xb)
-            Fx[:, k] += eta * tree.leaf_value[leaf]
-            round_trees.append(tree)
+        responses = (Y - P).T.astype(np.float32)  # [K, N]
+        Wk = np.tile(mask.astype(np.float32), (K, 1))
+        round_rngs = [np.random.RandomState(rng.randint(0, 2 ** 31))
+                      for _ in range(K)]
+        round_trees = grow_forest(
+            Xb, responses, Wk, nominal_mask, n_bins,
+            classification=False, max_depth=depth, min_split=min_split,
+            min_leaf=cl.get_int("min_samples_leaf", 1),
+            max_leaf_nodes=cl.get_int("leafs", 512),
+            num_vars=num_vars, rngs=round_rngs)
+        leaf_vals = np.asarray(
+            predict_forest_binned(stack_trees(round_trees), Xb))  # [K, N]
+        Fx += eta * leaf_vals.T
         rounds.append(round_trees)
     return TrainedGBT(rounds, intercept, eta, classes, bins)

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,9 @@ class TreeArrays:
     leaf_dist: Optional[np.ndarray]  # [M, C] classification posteriors
     leaf_value: np.ndarray  # [M] regression output / argmax class
     n_nodes: int
+    # accumulated impurity gain per feature (the reference's variable
+    # importance, RandomForestClassifierUDTF importance accumulation)
+    importance: Optional[np.ndarray] = None
 
     @property
     def max_depth_used(self) -> int:
@@ -158,8 +161,7 @@ def _best_split_regression(stats, nominal_mask, feat_ok, min_leaf: float = 1.0):
     return best_gain, best // B, best % B, node_stats[:, 0], mean
 
 
-@jax.jit
-def _update_assign(Xb, assign, feat, thr, nominal, leftslot, rightslot, isleaf):
+def _route(Xb, assign, feat, thr, nominal, leftslot, rightslot, isleaf):
     """Route rows to next-level slots (-1 = settled in a leaf)."""
     slot = jnp.maximum(assign, 0)
     f = feat[slot]
@@ -169,6 +171,53 @@ def _update_assign(Xb, assign, feat, thr, nominal, leftslot, rightslot, isleaf):
     nxt = jnp.where(go_left, leftslot[slot], rightslot[slot])
     nxt = jnp.where(isleaf[slot], -1, nxt)
     return jnp.where(assign < 0, -1, nxt)
+
+
+_update_assign = jax.jit(_route)
+# same routing for a whole group of trees: assign/feat/... gain a tree axis
+_update_assign_forest = jax.jit(
+    jax.vmap(_route, in_axes=(None, 0, 0, 0, 0, 0, 0, 0)))
+
+
+@partial(jax.jit, static_argnums=(4, 5, 6))
+def _hist_classification_forest(Xb, y, W, assign, S: int, B: int, C: int):
+    """Class histograms for a GROUP of trees in one scatter.
+
+    Xb [N,F] shared binned rows; W [G,N] per-tree bootstrap weights;
+    assign [G,N] per-tree frontier slots. Returns [G*S, F, B, C] laid out so
+    the single-tree split kernels apply unchanged over the flattened
+    (tree, slot) axis."""
+    N, F = Xb.shape
+    G = W.shape[0]
+    fidx = jnp.arange(F)[None, None, :]
+    slot = assign[:, :, None]  # [G, N, 1]
+    tid = jnp.arange(G)[:, None, None]
+    flat = (((tid * S + slot) * F + fidx) * B + Xb[None, :, :]) * C + y[None, :, None]
+    flat = jnp.where(slot >= 0, flat, G * S * F * B * C)
+    hist = jnp.zeros((G * S * F * B * C,), jnp.float32).at[flat.reshape(-1)].add(
+        jnp.broadcast_to(W[:, :, None], (G, N, F)).reshape(-1), mode="drop")
+    return hist.reshape(G * S, F, B, C)
+
+
+@partial(jax.jit, static_argnums=(4, 5))
+def _hist_regression_forest(Xb, y, W, assign, S: int, B: int):
+    """[G*S, F, B, 3] (count, sum, sumsq) histograms for a group of trees.
+    y is [G, N] — per-tree targets (GBT grows K class-trees per round on
+    different residuals; plain forests broadcast one target row)."""
+    N, F = Xb.shape
+    G = W.shape[0]
+    fidx = jnp.arange(F)[None, None, :]
+    slot = assign[:, :, None]
+    tid = jnp.arange(G)[:, None, None]
+    flat = ((tid * S + slot) * F + fidx) * B + Xb[None, :, :]
+    flat = jnp.where(slot >= 0, flat, G * S * F * B).reshape(-1)
+    size = G * S * F * B
+    wN = jnp.broadcast_to(W[:, :, None], (G, N, F)).reshape(-1)
+    yN = jnp.broadcast_to(y[:, :, None], (G, N, F)).reshape(-1)
+    cnt = jnp.zeros((size,), jnp.float32).at[flat].add(wN, mode="drop")
+    s = jnp.zeros((size,), jnp.float32).at[flat].add(wN * yN, mode="drop")
+    s2 = jnp.zeros((size,), jnp.float32).at[flat].add(wN * yN * yN, mode="drop")
+    return jnp.stack([cnt, s, s2], axis=-1).reshape(G * S, F, B, 3)
 
 
 def grow_tree(
@@ -205,6 +254,7 @@ def grow_tree(
     right: List[int] = []
     dists: List[np.ndarray] = []
     values: List[float] = []
+    importance = np.zeros(F)
 
     def new_node():
         feature.append(-1)
@@ -281,6 +331,7 @@ def grow_tree(
             feature[nid] = int(bf[s])
             thr[nid] = int(bb[s])
             nom[nid] = bool(nominal_mask[bf[s]])
+            importance[feature[nid]] += float(gain[s])
             l, r = new_node(), new_node()
             left[nid], right[nid] = l, r
             leftslot[s] = len(next_frontier)
@@ -321,7 +372,228 @@ def grow_tree(
         leaf_dist=leaf_dist,
         leaf_value=np.asarray(values, np.float32),
         n_nodes=M,
+        importance=importance,
     )
+
+
+class _TreeBuild:
+    """Host-side bookkeeping for one tree growing inside a forest group."""
+
+    __slots__ = ("feature", "thr", "nom", "left", "right", "dists", "values",
+                 "importance", "frontier", "n_leaves", "rng")
+
+    def __init__(self, rng, n_features: int):
+        self.feature: List[int] = []
+        self.thr: List[int] = []
+        self.nom: List[bool] = []
+        self.left: List[int] = []
+        self.right: List[int] = []
+        self.dists: List[Optional[np.ndarray]] = []
+        self.values: List[float] = []
+        self.importance = np.zeros(n_features)
+        self.rng = rng
+        self.frontier = [self.new_node()]
+        self.n_leaves = 1
+
+    def new_node(self) -> int:
+        self.feature.append(-1)
+        self.thr.append(0)
+        self.nom.append(False)
+        self.left.append(-1)
+        self.right.append(-1)
+        self.dists.append(None)
+        self.values.append(0.0)
+        return len(self.feature) - 1
+
+    def finish(self, classification: bool, n_classes: int) -> TreeArrays:
+        M = len(self.feature)
+        leaf_dist = None
+        if classification:
+            leaf_dist = np.zeros((M, n_classes), np.float32)
+            for i, d in enumerate(self.dists):
+                if d is not None:
+                    leaf_dist[i] = d
+        return TreeArrays(
+            feature=np.asarray(self.feature, np.int32),
+            threshold_bin=np.asarray(self.thr, np.int32),
+            nominal=np.asarray(self.nom, bool),
+            left=np.asarray(self.left, np.int32),
+            right=np.asarray(self.right, np.int32),
+            leaf_dist=leaf_dist,
+            leaf_value=np.asarray(self.values, np.float32),
+            n_nodes=M,
+            importance=self.importance,
+        )
+
+
+def grow_forest(
+    Xb: np.ndarray,  # [N, F] int32 binned (shared by all trees)
+    y: np.ndarray,  # [N] int (classification) or float (regression)
+    W: np.ndarray,  # [T, N] float32 per-tree bootstrap weights
+    nominal_mask: np.ndarray,
+    n_bins: int,
+    *,
+    classification: bool,
+    n_classes: int = 0,
+    rule: str = "gini",
+    max_depth: int = 10,
+    min_split: int = 2,
+    min_leaf: int = 1,
+    max_leaf_nodes: int = 512,
+    num_vars: Optional[int] = None,
+    rngs: Optional[Sequence[np.random.RandomState]] = None,
+    hist_budget_bytes: int = 1 << 26,
+) -> List[TreeArrays]:
+    """Grow ALL trees of a forest level-synchronously.
+
+    Where the reference runs one TrainingTask per tree on a JVM thread pool
+    (ref: smile/utils/SmileTaskExecutor.java:63-78), here the whole forest
+    advances one level per step: per level, ONE scatter-add builds every
+    tree's (node, feature, bin) histograms and one kernel scores every split
+    — the per-tree dispatch overhead of growing trees one at a time is gone.
+    Groups of trees are chunked so the histogram stays under
+    `hist_budget_bytes`; chunk shapes are padded to fixed sizes so the set of
+    compiled kernels stays O(log max_frontier) across the whole forest.
+
+    Each tree draws its per-node feature subspace from its OWN rng, so
+    `grow_forest(..., rngs=[r0..])` reproduces `grow_tree(..., rng=r_t)`
+    exactly (parity-tested)."""
+    N, F = Xb.shape
+    T = W.shape[0]
+    stat_w = n_classes if classification else 3
+    rngs = list(rngs) if rngs is not None else [
+        np.random.RandomState(t) for t in range(T)]
+    Xbj = jnp.asarray(Xb, jnp.int32)
+    y = np.asarray(y)
+    per_tree_y = (not classification) and y.ndim == 2
+    yj = jnp.asarray(y, jnp.int32 if classification else jnp.float32)
+    Wj = jnp.asarray(W, jnp.float32)
+    nomj = jnp.asarray(nominal_mask)
+
+    builds = [_TreeBuild(rngs[t], F) for t in range(T)]
+    assign = jnp.zeros((T, N), jnp.int32)
+
+    for depth in range(max_depth + 1):
+        # sort active trees by frontier size so chunks group similar shapes
+        # and each chunk pads S only to ITS largest frontier
+        act = sorted((t for t in range(T) if builds[t].frontier),
+                     key=lambda t: -len(builds[t].frontier))
+        if not act:
+            break
+        c0 = 0
+        while c0 < len(act):
+            S = len(builds[act[c0]].frontier)
+            S_pad = 1
+            while S_pad < S:
+                S_pad <<= 1
+            # chunk the tree axis so [G, S, F, B, C] fits the budget; G is a
+            # power of two (plus drop-masking) so compiled shapes stay bounded
+            per_tree = S_pad * F * n_bins * stat_w * 4
+            G = max(1, min(64, len(act) - c0, hist_budget_bytes // max(per_tree, 1)))
+            while G & (G - 1):
+                G &= G - 1
+            chunk = act[c0:c0 + G]
+            c0 += G
+            g = len(chunk)
+            # dummy pad slots point PAST the tree axis so the write-back
+            # scatter drops them (duplicate in-range indices would race)
+            idx = np.full(G, T, np.int64)
+            idx[:g] = chunk
+            valid = np.zeros(G, bool)
+            valid[:g] = True
+            idxj = jnp.asarray(idx)
+            validj = jnp.asarray(valid)
+            W_c = jnp.where(validj[:, None], Wj[jnp.minimum(idxj, T - 1)], 0.0)
+            a_c = jnp.where(validj[:, None], assign[jnp.minimum(idxj, T - 1)], -1)
+
+            feat_ok = np.zeros((G * S_pad, F), bool)
+            for ci, t in enumerate(chunk):
+                b = builds[t]
+                if num_vars is None or num_vars >= F:
+                    feat_ok[ci * S_pad:ci * S_pad + len(b.frontier)] = True
+                else:
+                    for s in range(len(b.frontier)):
+                        feat_ok[ci * S_pad + s,
+                                b.rng.choice(F, size=num_vars, replace=False)] = True
+            feat_okj = jnp.asarray(feat_ok)
+
+            if classification:
+                hist = _hist_classification_forest(
+                    Xbj, yj, W_c, a_c, S_pad, n_bins, n_classes)
+                gain, bf, bb, counts = _best_split_classification(
+                    hist, nomj, feat_okj, rule, float(min_leaf))
+                gain = np.asarray(gain)
+                bf = np.asarray(bf)
+                bb = np.asarray(bb)
+                counts = np.asarray(counts)
+                node_sizes = counts.sum(-1)
+            else:
+                if per_tree_y:
+                    y_c = jnp.where(validj[:, None], yj[jnp.minimum(idxj, T - 1)], 0.0)
+                else:
+                    y_c = jnp.broadcast_to(yj[None, :], (G, N))
+                stats = _hist_regression_forest(Xbj, y_c, W_c, a_c, S_pad, n_bins)
+                gain, bf, bb, cnts, means = _best_split_regression(
+                    stats, nomj, feat_okj, float(min_leaf))
+                gain = np.asarray(gain)
+                bf = np.asarray(bf)
+                bb = np.asarray(bb)
+                node_sizes = np.asarray(cnts)
+                means = np.asarray(means)
+
+            # host split decisions per tree (same policy as grow_tree)
+            isleaf = np.ones((G, S_pad), bool)
+            leftslot = np.full((G, S_pad), -1, np.int32)
+            rightslot = np.full((G, S_pad), -1, np.int32)
+            feat_arr = np.zeros((G, S_pad), np.int32)
+            thr_arr = np.zeros((G, S_pad), np.int32)
+            nom_arr = np.zeros((G, S_pad), bool)
+            any_next = False
+            for ci, t in enumerate(chunk):
+                b = builds[t]
+                frontier = b.frontier
+                next_frontier: List[int] = []
+                for s, nid in enumerate(frontier):
+                    k = ci * S_pad + s
+                    if classification:
+                        b.dists[nid] = counts[k]
+                        b.values[nid] = float(np.argmax(counts[k]))
+                    else:
+                        b.values[nid] = float(means[k])
+                    can_split = (
+                        depth < max_depth
+                        and gain[k] > 1e-7
+                        and node_sizes[k] >= min_split
+                        and b.n_leaves < max_leaf_nodes
+                    )
+                    if not can_split:
+                        continue
+                    isleaf[ci, s] = False
+                    b.feature[nid] = int(bf[k])
+                    b.thr[nid] = int(bb[k])
+                    b.nom[nid] = bool(nominal_mask[bf[k]])
+                    b.importance[b.feature[nid]] += float(gain[k])
+                    l, r = b.new_node(), b.new_node()
+                    b.left[nid], b.right[nid] = l, r
+                    leftslot[ci, s] = len(next_frontier)
+                    next_frontier.append(l)
+                    rightslot[ci, s] = len(next_frontier)
+                    next_frontier.append(r)
+                    b.n_leaves += 1
+                    feat_arr[ci, s] = b.feature[nid]
+                    thr_arr[ci, s] = b.thr[nid]
+                    nom_arr[ci, s] = b.nom[nid]
+                b.frontier = next_frontier
+                any_next = any_next or bool(next_frontier)
+
+            if any_next:
+                routed = _update_assign_forest(
+                    Xbj, a_c, jnp.asarray(feat_arr), jnp.asarray(thr_arr),
+                    jnp.asarray(nom_arr), jnp.asarray(leftslot),
+                    jnp.asarray(rightslot), jnp.asarray(isleaf))
+                assign = assign.at[idxj].set(routed, mode="drop")
+
+    return [b.finish(classification, n_classes) for b in builds]
 
 
 def stack_trees(trees) -> dict:

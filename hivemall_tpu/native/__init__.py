@@ -134,10 +134,12 @@ def encode_records(idx_rows: Sequence[np.ndarray],
             raise ValueError(f"row {i}: {len(r)} indices vs "
                              f"{len(val_rows[i])} values")
         offsets[i + 1] = offsets[i] + len(r)
-    indices = (np.concatenate(idx_rows).astype(np.int64) if n else
-               np.zeros(0, np.int64))
-    values = (np.concatenate(val_rows).astype(np.float32) if n else
-              np.zeros(0, np.float32))
+    indices = (np.ascontiguousarray(
+        np.concatenate(idx_rows).astype(np.int64, copy=False)) if n else
+        np.zeros(0, np.int64))
+    values = (np.ascontiguousarray(
+        np.concatenate(val_rows).astype(np.float32, copy=False)) if n else
+        np.zeros(0, np.float32))
     labs = np.ascontiguousarray(labels, dtype=np.float32)
     cap = int(lib.hm_encode_records_bound(
         offsets.ctypes.data_as(ctypes.c_void_p), n))

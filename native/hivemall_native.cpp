@@ -179,7 +179,13 @@ int64_t hm_encode_records(const int64_t* indices, const float* values,
             if (indices[start + k] < 0) return -1;
             row.emplace_back(indices[start + k], values[start + k]);
         }
-        std::sort(row.begin(), row.end());
+        // stable, id-only: equal-id entries (hash collisions) keep input
+        // order so the byte stream matches the Python fallback exactly
+        std::stable_sort(row.begin(), row.end(),
+                         [](const std::pair<int64_t, float>& a,
+                            const std::pair<int64_t, float>& b) {
+                             return a.first < b.first;
+                         });
         if (pos + 1 + nnz * 14 + 4 > cap) return -1;
         out[pos++] = static_cast<uint8_t>(nnz);
         int64_t prev = 0;

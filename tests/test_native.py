@@ -53,7 +53,7 @@ def _python_encode_shard_body(idx_rows, val_rows, labels):
     out = bytearray()
     for idx, val, lab in zip(idx_rows, val_rows, labels):
         idx = np.asarray(idx, np.int64)
-        order = np.argsort(idx)
+        order = np.argsort(idx, kind="stable")
         idx = idx[order]
         val = np.asarray(val, np.float32)[order]
         out.append(len(idx))
@@ -158,3 +158,23 @@ def test_zigzag_leb128_uint64_array_uses_python_path():
     v = np.array([2**63 + 5], dtype=np.uint64)
     enc = zigzag_leb128_encode_array(v)
     assert zigzag_leb128_decode_array(enc, 1) == [2**63 + 5]
+
+
+def test_encode_records_duplicate_ids_bit_identical():
+    """Hash-collision rows (duplicate feature ids) must produce the same
+    bytes on the native and Python paths: both sort stably by id only, so
+    equal-id entries keep input order."""
+    idx_rows = [np.array([7, 7, 7, 3], np.int64),
+                np.array([5, 5], np.int64),
+                np.array([9, 1, 9, 1, 9], np.int64)]
+    val_rows = [np.array([9.0, 1.0, 5.0, 2.0], np.float32),
+                np.array([2.0, -2.0], np.float32),
+                np.array([1.0, 2.0, 3.0, 4.0, 5.0], np.float32)]
+    labels = np.array([1.0, -1.0, 0.5], np.float32)
+    body = native.encode_records(idx_rows, val_rows, labels)
+    assert body == _python_encode_shard_body(idx_rows, val_rows, labels)
+    offsets, indices, values, _ = native.decode_records(body, 3)
+    # row 0: id 3 first, then the three 7s in input value order
+    np.testing.assert_array_equal(indices[offsets[0]:offsets[1]], [3, 7, 7, 7])
+    np.testing.assert_array_equal(values[offsets[0]:offsets[1]],
+                                  [2.0, 9.0, 1.0, 5.0])

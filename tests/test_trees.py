@@ -155,3 +155,102 @@ class TestGBT:
             X, y, "-trees 20 -eta 0.2 -depth 3 -seed 12")
         acc = np.mean(gbt.predict(X) == y)
         assert acc > 0.93, acc
+
+
+class TestForestBatchedGrowth:
+    """grow_forest (level-synchronous whole-forest growth) must reproduce
+    grow_tree exactly when given the same per-tree rng streams."""
+
+    def _parity(self, classification):
+        from hivemall_tpu.models.trees.grow import grow_forest
+
+        rng = np.random.RandomState(7)
+        X = rng.rand(400, 5)
+        if classification:
+            y = ((X[:, 0] > 0.4) & (X[:, 3] < 0.6)).astype(int)
+        else:
+            # integer-valued targets keep histogram sums exact in fp32, so
+            # scatter summation order (which differs between the batched and
+            # per-tree paths) cannot flip near-tie split choices
+            y = (np.floor(4 * X[:, 1]) - np.floor(2 * X[:, 4])).astype(np.float32)
+        bins = make_bins(X, ["Q"] * 5)
+        Xb = bin_data(X, bins)
+        n_bins = max(b.n_bins for b in bins)
+        nominal = np.zeros(5, bool)
+        T_ = 5
+        W = np.stack([
+            np.bincount(np.random.RandomState(100 + t).randint(0, 400, 400),
+                        minlength=400).astype(np.float32)
+            for t in range(T_)])
+        kw = dict(n_bins=n_bins, classification=classification,
+                  max_depth=6, min_split=2, min_leaf=1, max_leaf_nodes=64,
+                  num_vars=3)
+        if classification:
+            kw["n_classes"] = 2
+        forest = grow_forest(Xb, y, W, nominal, rngs=[
+            np.random.RandomState(200 + t) for t in range(T_)], **kw)
+        for t in range(T_):
+            solo = grow_tree(Xb, y, W[t], nominal,
+                             rng=np.random.RandomState(200 + t), **kw)
+            np.testing.assert_array_equal(forest[t].feature, solo.feature)
+            np.testing.assert_array_equal(forest[t].threshold_bin,
+                                          solo.threshold_bin)
+            np.testing.assert_array_equal(forest[t].left, solo.left)
+            np.testing.assert_array_equal(forest[t].right, solo.right)
+            np.testing.assert_allclose(forest[t].leaf_value, solo.leaf_value)
+
+    def test_forest_matches_per_tree_classification(self):
+        self._parity(True)
+
+    def test_forest_matches_per_tree_regression(self):
+        self._parity(False)
+
+    def test_small_hist_budget_chunks_groups(self):
+        from hivemall_tpu.models.trees.grow import grow_forest
+
+        rng = np.random.RandomState(3)
+        X = rng.rand(200, 4)
+        y = (X[:, 0] > 0.5).astype(int)
+        bins = make_bins(X, ["Q"] * 4)
+        Xb = bin_data(X, bins)
+        n_bins = max(b.n_bins for b in bins)
+        W = np.ones((6, 200), np.float32)
+        kw = dict(n_bins=n_bins, classification=True, n_classes=2,
+                  max_depth=4, min_split=2, min_leaf=1, max_leaf_nodes=32,
+                  num_vars=None)
+        big = grow_forest(Xb, y, W, np.zeros(4, bool),
+                          rngs=[np.random.RandomState(t) for t in range(6)], **kw)
+        # budget forcing G=1 (one tree per device pass) must not change output
+        small = grow_forest(Xb, y, W, np.zeros(4, bool),
+                            rngs=[np.random.RandomState(t) for t in range(6)],
+                            hist_budget_bytes=1, **kw)
+        for a, b in zip(big, small):
+            np.testing.assert_array_equal(a.feature, b.feature)
+            np.testing.assert_allclose(a.leaf_value, b.leaf_value)
+
+    def test_per_tree_targets(self):
+        """y as [T, N] (GBT residuals) must match growing each tree on its
+        own target row."""
+        from hivemall_tpu.models.trees.grow import grow_forest, grow_tree
+
+        rng = np.random.RandomState(11)
+        X = rng.rand(300, 4)
+        Y = np.stack([
+            np.floor(3 * X[:, 0]).astype(np.float32),
+            np.floor(5 * X[:, 2]).astype(np.float32),
+            (np.floor(2 * X[:, 1]) - np.floor(2 * X[:, 3])).astype(np.float32),
+        ])
+        bins = make_bins(X, ["Q"] * 4)
+        Xb = bin_data(X, bins)
+        n_bins = max(b.n_bins for b in bins)
+        W = np.ones((3, 300), np.float32)
+        kw = dict(n_bins=n_bins, classification=False, max_depth=5,
+                  min_split=2, min_leaf=1, max_leaf_nodes=64, num_vars=None)
+        forest = grow_forest(Xb, Y, W, np.zeros(4, bool),
+                             rngs=[np.random.RandomState(t) for t in range(3)],
+                             **kw)
+        for t in range(3):
+            solo = grow_tree(Xb, Y[t], W[t], np.zeros(4, bool),
+                             rng=np.random.RandomState(t), **kw)
+            np.testing.assert_array_equal(forest[t].feature, solo.feature)
+            np.testing.assert_allclose(forest[t].leaf_value, solo.leaf_value)
