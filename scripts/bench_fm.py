@@ -1,0 +1,67 @@
+"""FM training throughput at the CTR shape (2^22 dims, k=5, 32 nnz/row),
+HBM-staged blocks — the train_fm counterpart of bench.py's AROW headline.
+
+Run (real chip): python scripts/bench_fm.py
+Run (CPU):       PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/bench_fm.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from hivemall_tpu.models.fm import FMHyper, init_fm_state, make_fm_step
+
+    platform = jax.devices()[0].platform
+    dims = 1 << 22
+    batch = 16384
+    width = 32
+    n_blocks = 8
+
+    rng = np.random.RandomState(0)
+    idx = (rng.zipf(1.3, size=(n_blocks, batch, width)) % dims).astype(np.int32)
+    val = np.ones((n_blocks, batch, width), dtype=np.float32)
+    lab = np.sign(rng.randn(n_blocks, batch)).astype(np.float32)
+    no_va = np.zeros((batch,), dtype=bool)
+
+    idx_d = [jnp.asarray(idx[b]) for b in range(n_blocks)]
+    val_d = [jnp.asarray(val[b]) for b in range(n_blocks)]
+    lab_d = [jnp.asarray(lab[b]) for b in range(n_blocks)]
+    va_d = jnp.asarray(no_va)
+
+    hyper = FMHyper(factors=5, classification=True)
+    step = make_fm_step(hyper, mode="minibatch")
+    state = init_fm_state(dims, hyper)
+
+    state, loss = step(state, idx_d[0], val_d[0], lab_d[0], va_d)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    rounds = 40
+    total_rows = 0
+    for _ in range(rounds):
+        for b in range(n_blocks):
+            state, loss = step(state, idx_d[b], val_d[b], lab_d[b], va_d)
+            total_rows += batch
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    rows_per_sec = total_rows / dt
+    print(json.dumps({
+        "metric": f"fm_train_throughput_2^22dims_k5_{width}nnz_hbm_staged_{platform}",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/sec",
+        "ms_per_step": round(1e3 * dt / (rounds * n_blocks), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
