@@ -148,12 +148,22 @@ def _export(tree: TreeArrays, bins, output: str) -> Tuple[str, str]:
     return "json", to_json(tree, bins)
 
 
-def train_randomforest_classifier(X, labels, options: Optional[str] = None
-                                  ) -> TrainedForest:
+def train_randomforest_classifier(X, labels, options: Optional[str] = None,
+                                  classes=None) -> TrainedForest:
+    """`classes`: optional GLOBAL label list — pass it when training shards
+    on data partitions so every shard's exported trees vote in the same
+    class-index space even if a partition is missing some class
+    (parallel/forest_shard.py does this)."""
     cl = _forest_options().parse(options, "train_randomforest_classifier")
     X = np.asarray(X, dtype=np.float64)
     y = np.asarray(labels)
-    classes, y_idx = np.unique(y, return_inverse=True)
+    if classes is None:
+        classes, y_idx = np.unique(y, return_inverse=True)
+    else:
+        classes = np.unique(np.asarray(classes))  # sorted, like np.unique(y)
+        y_idx = np.searchsorted(classes, y)
+        if np.any(classes[np.clip(y_idx, 0, len(classes) - 1)] != y):
+            raise ValueError("labels contain values not in `classes`")
     n_classes = len(classes)
     N, F = X.shape
     attrs = _resolve_attrs(cl.get("attrs"), F)

@@ -1,0 +1,145 @@
+"""Multi-process (multi-host) random-forest training.
+
+The reference trains forests across the cluster by letting EACH Hive mapper
+train its own trees on its data partition and emitting per-tree model rows;
+prediction then majority-votes over all emitted trees with rf_ensemble
+(ref: smile/classification/RandomForestClassifierUDTF.java:343-351,
+smile/tools/RandomForestEnsembleUDAF.java:34). TPU-first the same topology
+holds: each jax process (host) grows its shard of the forest with
+`grow_forest`'s batched device kernels on its local rows, and the exported
+model rows — opcode/json programs evaluating on RAW feature units — merge
+process-agnostically, exactly like the reference's model-table rows.
+
+This module is the glue: tree-count sharding, disjoint global model ids,
+decorrelated per-process seeds, a consistent global class-index space, and
+the row-level ensemble evaluator used to predict from merged rows
+(model rows are the 6-tuples forest.model_rows() emits:
+(model_id, model_type, model, var_importance, oob_errors, oob_tests)).
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ensemble import rf_ensemble
+from ..models.trees.export import eval_json_tree
+from ..models.trees.forest import (TrainedForest, train_randomforest_classifier,
+                                   train_randomforest_regr)
+from ..models.trees.vm import StackMachine
+
+
+def shard_tree_counts(total_trees: int, process_count: int) -> List[int]:
+    """Near-even split of the forest across processes (first shards take the
+    remainder — the same arithmetic Hadoop uses for map splits)."""
+    base, rem = divmod(total_trees, process_count)
+    return [base + (1 if p < rem else 0) for p in range(process_count)]
+
+
+def _resolve_process(process_index: Optional[int], process_count: Optional[int]
+                     ) -> Tuple[int, int]:
+    if process_index is not None and process_count is not None:
+        return process_index, process_count
+    import jax
+
+    return jax.process_index(), jax.process_count()
+
+
+def _split_opt(options: str) -> Tuple[int, int, List[str]]:
+    """Pull -trees and -seed out of an option string (shlex-tokenized like
+    Options.parse), keep the rest verbatim."""
+    kept: List[str] = []
+    toks = shlex.split(options or "")
+    i = 0
+    trees, seed = 50, -1
+    while i < len(toks):
+        t = toks[i]
+        if t in ("-trees", "--num_trees", "-seed", "--seed"):
+            if i + 1 >= len(toks):
+                raise ValueError(f"option {t} requires a value")
+            if t in ("-trees", "--num_trees"):
+                trees = int(toks[i + 1])
+            else:
+                seed = int(toks[i + 1])
+            i += 2
+        else:
+            kept.append(t)
+            i += 1
+    return trees, seed, kept
+
+
+def train_randomforest_sharded(
+    X, y, options: str = "", *, classification: bool = True,
+    classes=None, process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> TrainedForest:
+    """Train THIS process's shard of the forest on its local (X, y) partition.
+
+    `-trees N` in `options` is the GLOBAL forest size; this process grows its
+    `shard_tree_counts` share with a seed decorrelated by process index
+    (`-seed` omitted stays nondeterministic, like the trainers) and model ids
+    offset so rows from all processes merge without collision — the
+    in-framework equivalent of one mapper's emission.
+
+    `classes`: the GLOBAL label list. Pass it whenever partitions may miss a
+    class — each shard's trees then vote in the same class-index space. When
+    None, the global labels are taken from the LOCAL partition (safe only if
+    every partition contains every class)."""
+    p, P = _resolve_process(process_index, process_count)
+    total, seed, kept = _split_opt(options)
+    counts = shard_tree_counts(total, P)
+    local = counts[p]
+    offset = sum(counts[:p])
+    if local == 0:
+        return TrainedForest([], classification,
+                             0 if classes is None else len(np.unique(classes)),
+                             [], [])
+    opt_parts = kept + [f"-trees {local}"]
+    if seed >= 0:
+        opt_parts.append(f"-seed {seed * 7919 + p}")
+    opt = " ".join(opt_parts)
+    if classification:
+        forest = train_randomforest_classifier(X, y, opt, classes=classes)
+    else:
+        forest = train_randomforest_regr(X, y, opt)
+    for t in forest.trees:
+        t.model_id += offset
+    return forest
+
+
+def _compile_row(model_type: str, model: str):
+    """Parse/compile one exported tree program ONCE; returns features->value."""
+    mt = model_type.lower()
+    if mt in ("opscode", "vm"):
+        sm = StackMachine()
+        sm.compile(model)
+        return lambda x: sm.eval(x)
+    if mt in ("json", "serialization", "ser"):
+        node = json.loads(model)
+        return lambda x: eval_json_tree(node, x)
+    raise ValueError(f"unsupported model type: {model_type}")
+
+
+def ensemble_predict_rows(model_rows: Sequence[Tuple], X,
+                          classification: bool = True,
+                          classes=None) -> np.ndarray:
+    """Predict from MERGED per-tree model rows (any mix of processes):
+    evaluate each exported tree program on raw features and rf_ensemble the
+    votes — the reference's tree_predict + rf_ensemble SQL plan. Programs are
+    compiled once, not per row. `classes` (classification): map the voted
+    class indices back to original labels."""
+    X = np.asarray(X, dtype=np.float64)
+    evals = [_compile_row(row[1], row[2]) for row in model_rows]
+    out = np.empty(X.shape[0], dtype=np.float64)
+    for r in range(X.shape[0]):
+        votes = [ev(X[r]) for ev in evals]
+        if classification:
+            out[r] = rf_ensemble(int(v) for v in votes)[0]
+        else:
+            out[r] = float(np.mean(votes))
+    if classification and classes is not None:
+        return np.unique(np.asarray(classes))[out.astype(int)]
+    return out

@@ -1,0 +1,116 @@
+"""Multi-process forest sharding: each 'process' trains its shard on its data
+partition; merged model rows predict via tree_predict + rf_ensemble (the
+reference's mapper-per-tree-subset topology, SURVEY.md §2.8)."""
+
+import numpy as np
+
+from hivemall_tpu.parallel.forest_shard import (ensemble_predict_rows,
+                                                shard_tree_counts,
+                                                train_randomforest_sharded)
+
+
+def test_shard_tree_counts():
+    assert shard_tree_counts(50, 4) == [13, 13, 12, 12]
+    assert sum(shard_tree_counts(7, 3)) == 7
+    assert shard_tree_counts(2, 4) == [1, 1, 0, 0]
+
+
+def _gen(n=1200, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 6)
+    y = ((X[:, 0] > 0.5) ^ (X[:, 2] > 0.5)).astype(int)
+    return X, y
+
+
+def test_sharded_forest_merges_and_predicts():
+    X, y = _gen()
+    P = 3
+    all_rows = []
+    seen_ids = set()
+    # each process trains on ITS data partition (row stripes)
+    for p in range(P):
+        Xp, yp = X[p::P], y[p::P]
+        f = train_randomforest_sharded(
+            Xp, yp, "-trees 12 -depth 8 -seed 5", classification=True,
+            process_index=p, process_count=P)
+        rows = f.model_rows()
+        assert len(rows) == 4  # 12 trees / 3 processes
+        for r in rows:
+            assert r[0] not in seen_ids, "model ids must be globally disjoint"
+            seen_ids.add(r[0])
+        all_rows.extend(rows)
+    assert seen_ids == set(range(12))
+    pred = ensemble_predict_rows(all_rows, X[:300], classification=True)
+    acc = float(np.mean(pred == y[:300]))
+    assert acc > 0.9, f"merged-forest accuracy {acc}"
+
+
+def test_sharded_forest_regression():
+    rng = np.random.RandomState(2)
+    X = rng.rand(900, 5)
+    yr = 2.0 * X[:, 1] + X[:, 3]
+    rows = []
+    for p in range(2):
+        f = train_randomforest_sharded(
+            X[p::2], yr[p::2], "-trees 8 -depth 8 -seed 9",
+            classification=False, process_index=p, process_count=2)
+        rows.extend(f.model_rows())
+    pred = ensemble_predict_rows(rows, X[:200], classification=False)
+    mse = float(np.mean((pred - yr[:200]) ** 2))
+    assert mse < 0.05, f"merged regression mse {mse}"
+
+
+def test_zero_tree_shard():
+    X, y = _gen(300)
+    f = train_randomforest_sharded(X, y, "-trees 2 -depth 4 -seed 1",
+                                   process_index=3, process_count=4)
+    assert f.model_rows() == []
+
+
+def test_sharded_multiclass_missing_class_in_partition():
+    """A partition that lacks one class must still vote in the GLOBAL
+    class-index space when `classes` is passed."""
+    rng = np.random.RandomState(4)
+    X = rng.rand(1500, 5)
+    y = np.digitize(X[:, 0], [0.33, 0.66])  # 3 classes from feature 0
+    # partition 0 is missing class 1 entirely (locally it sees labels {0, 2},
+    # which WOULD collapse to indices {0, 1} without the global class list);
+    # partitions 1 and 2 are plain row stripes with all classes
+    stripe = np.arange(1500) % 3
+    parts = [(stripe == 0) & (y != 1), stripe == 1, stripe == 2]
+    rows = []
+    for p, m in enumerate(parts):
+        f = train_randomforest_sharded(
+            X[m], y[m], "-trees 15 -depth 8 -seed 3", classes=[0, 1, 2],
+            process_index=p, process_count=3)
+        rows.extend(f.model_rows())
+    pred = ensemble_predict_rows(rows, X[:400], classes=[0, 1, 2])
+    acc = float(np.mean(pred == y[:400]))
+    assert acc > 0.85, f"global-class-space accuracy {acc}"
+    # every class must be predictable (class 1 in particular: the majority of
+    # shards know it and partition 0's trees must not shadow it as class 2)
+    for c in range(3):
+        m = y[:400] == c
+        assert float(np.mean(pred[m] == c)) > 0.75, f"class {c} drowned out"
+
+
+def test_sharded_noncontiguous_labels_map_back():
+    rng = np.random.RandomState(5)
+    X = rng.rand(800, 4)
+    y = np.where(X[:, 1] > 0.5, 7, 3)  # labels {3, 7}
+    f = train_randomforest_sharded(X, y, "-trees 6 -depth 6 -seed 2",
+                                   classes=[3, 7],
+                                   process_index=0, process_count=1)
+    pred = ensemble_predict_rows(f.model_rows(), X[:200], classes=[3, 7])
+    assert set(np.unique(pred)).issubset({3, 7})
+    assert float(np.mean(pred == y[:200])) > 0.9
+
+
+def test_split_opt_missing_value_raises():
+    import pytest
+
+    from hivemall_tpu.parallel.forest_shard import _split_opt
+
+    with pytest.raises(ValueError):
+        _split_opt("-depth 4 -trees")
+    assert _split_opt("-trees 8 -depth 4 -seed 9") == (8, 9, ["-depth", "4"])
