@@ -12,22 +12,36 @@ from .export import eval_json_tree
 from .vm import StackMachine
 
 
+def compile_tree(model_type: str, model: str):
+    """Parse/compile one exported tree program ONCE; returns a
+    features -> float evaluator. The single model-type dispatch table —
+    tree_predict and the merged-row ensemble both go through it."""
+    mt = model_type.lower()
+    if mt in ("opscode", "vm"):
+        sm = StackMachine()
+        sm.compile(model)
+
+        def run_vm(features):
+            result = sm.eval(features)
+            if result is None:
+                raise ValueError("opscode evaluation returned no result")
+            return result
+
+        return run_vm
+    if mt in ("json", "serialization", "ser"):
+        node = json.loads(model) if isinstance(model, str) else model
+        return lambda features: eval_json_tree(node, list(features))
+    raise ValueError(f"unsupported model type: {model_type}")
+
+
 def tree_predict(model_type: str, model: str, features: Sequence[float],
                  classification: bool = True) -> Union[int, float]:
     """Evaluate an exported tree on one raw feature vector. Evaluators:
     opscode -> StackMachine (ref: TreePredictUDF.java:257), json -> node-graph
     walk (the serialization-evaluator analog, :205), javascript unsupported
     off-JVM (Rhino, :326) — export json/opscode instead."""
-    mt = model_type.lower()
-    if mt in ("opscode", "vm"):
-        result = StackMachine().run(model, features)
-        if result is None:
-            raise ValueError("opscode evaluation returned no result")
-        return int(result) if classification else float(result)
-    if mt in ("json", "serialization", "ser"):
-        out = eval_json_tree(model, list(features))
-        return int(out) if classification else float(out)
-    raise ValueError(f"unsupported model type: {model_type}")
+    out = compile_tree(model_type, model)(features)
+    return int(out) if classification else float(out)
 
 
 def guess_attrs(row: Sequence) -> str:

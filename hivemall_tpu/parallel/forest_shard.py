@@ -19,17 +19,15 @@ the row-level ensemble evaluator used to predict from merged rows
 
 from __future__ import annotations
 
-import json
 import shlex
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..ensemble import rf_ensemble
-from ..models.trees.export import eval_json_tree
 from ..models.trees.forest import (TrainedForest, train_randomforest_classifier,
                                    train_randomforest_regr)
-from ..models.trees.vm import StackMachine
+from ..models.trees.predict import compile_tree
 
 
 def shard_tree_counts(total_trees: int, process_count: int) -> List[int]:
@@ -50,20 +48,22 @@ def _resolve_process(process_index: Optional[int], process_count: Optional[int]
 
 def _split_opt(options: str) -> Tuple[int, int, List[str]]:
     """Pull -trees and -seed out of an option string (shlex-tokenized like
-    Options.parse), keep the rest verbatim."""
+    Options.parse, dash-insensitive like its option matching), keep the rest
+    verbatim."""
     kept: List[str] = []
     toks = shlex.split(options or "")
     i = 0
     trees, seed = 50, -1
     while i < len(toks):
         t = toks[i]
-        if t in ("-trees", "--num_trees", "-seed", "--seed"):
+        bare = t.lstrip("-") if t.startswith("-") else ""
+        if bare in ("trees", "num_trees", "seed"):
             if i + 1 >= len(toks):
                 raise ValueError(f"option {t} requires a value")
-            if t in ("-trees", "--num_trees"):
-                trees = int(toks[i + 1])
-            else:
+            if bare == "seed":
                 seed = int(toks[i + 1])
+            else:
+                trees = int(toks[i + 1])
             i += 2
         else:
             kept.append(t)
@@ -88,6 +88,8 @@ def train_randomforest_sharded(
     class — each shard's trees then vote in the same class-index space. When
     None, the global labels are taken from the LOCAL partition (safe only if
     every partition contains every class)."""
+    if classes is not None and not classification:
+        raise ValueError("`classes` only applies to classification forests")
     p, P = _resolve_process(process_index, process_count)
     total, seed, kept = _split_opt(options)
     counts = shard_tree_counts(total, P)
@@ -97,7 +99,7 @@ def train_randomforest_sharded(
         return TrainedForest([], classification,
                              0 if classes is None else len(np.unique(classes)),
                              [], [])
-    opt_parts = kept + [f"-trees {local}"]
+    opt_parts = [shlex.quote(t) for t in kept] + [f"-trees {local}"]
     if seed >= 0:
         opt_parts.append(f"-seed {seed * 7919 + p}")
     opt = " ".join(opt_parts)
@@ -110,29 +112,18 @@ def train_randomforest_sharded(
     return forest
 
 
-def _compile_row(model_type: str, model: str):
-    """Parse/compile one exported tree program ONCE; returns features->value."""
-    mt = model_type.lower()
-    if mt in ("opscode", "vm"):
-        sm = StackMachine()
-        sm.compile(model)
-        return lambda x: sm.eval(x)
-    if mt in ("json", "serialization", "ser"):
-        node = json.loads(model)
-        return lambda x: eval_json_tree(node, x)
-    raise ValueError(f"unsupported model type: {model_type}")
-
-
 def ensemble_predict_rows(model_rows: Sequence[Tuple], X,
                           classification: bool = True,
                           classes=None) -> np.ndarray:
     """Predict from MERGED per-tree model rows (any mix of processes):
     evaluate each exported tree program on raw features and rf_ensemble the
     votes — the reference's tree_predict + rf_ensemble SQL plan. Programs are
-    compiled once, not per row. `classes` (classification): map the voted
-    class indices back to original labels."""
+    compiled once (predict.compile_tree), not per row. `classes`
+    (classification): map the voted class indices back to original labels."""
+    if not model_rows:
+        raise ValueError("no model rows to ensemble")
     X = np.asarray(X, dtype=np.float64)
-    evals = [_compile_row(row[1], row[2]) for row in model_rows]
+    evals = [compile_tree(row[1], row[2]) for row in model_rows]
     out = np.empty(X.shape[0], dtype=np.float64)
     for r in range(X.shape[0]):
         votes = [ev(X[r]) for ev in evals]

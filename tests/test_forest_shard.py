@@ -114,3 +114,36 @@ def test_split_opt_missing_value_raises():
     with pytest.raises(ValueError):
         _split_opt("-depth 4 -trees")
     assert _split_opt("-trees 8 -depth 4 -seed 9") == (8, 9, ["-depth", "4"])
+
+
+def test_split_opt_dash_variants():
+    from hivemall_tpu.parallel.forest_shard import _split_opt
+
+    assert _split_opt("-num_trees 100")[0] == 100
+    assert _split_opt("--trees 64")[0] == 64
+    assert _split_opt("--num_trees 9 --seed 4") == (9, 4, [])
+
+
+def test_empty_rows_raise():
+    import pytest
+
+    with pytest.raises(ValueError):
+        ensemble_predict_rows([], np.zeros((3, 2)))
+
+
+def test_classes_rejected_for_regression():
+    import pytest
+
+    X, y = _gen(100)
+    with pytest.raises(ValueError):
+        train_randomforest_sharded(X, y.astype(float), classification=False,
+                                   classes=[0, 1], process_index=0,
+                                   process_count=1)
+
+
+def test_quoted_attrs_survive_rejoin():
+    X, y = _gen(400)
+    f = train_randomforest_sharded(
+        X, y, '-trees 4 -depth 6 -seed 1 -attrs "Q, Q, Q, Q, Q, Q"',
+        process_index=0, process_count=1)
+    assert len(f.model_rows()) == 4
