@@ -61,3 +61,32 @@ def test_mf_ml1k_heldout_rmse():
     test_rmse = rmse(pred, rt)
     # global-mean baseline is ~1.12 on this split; a real MF fit lands ~0.94
     assert test_rmse < 1.0, f"ml1k held-out rmse {test_rmse}"
+
+
+FFM_FILE = os.path.join(REF, "fm", "bigdata.tr.txt")
+
+
+@pytest.mark.skipif(not os.path.exists(FFM_FILE),
+                    reason="reference mount not available")
+def test_ffm_reference_dataset_loss_thresholds():
+    """Same libFFM data, options, and epoch count as the reference FFM test
+    (ref: core/src/test/java/hivemall/fm/FieldAwareFactorizationMachineUDTFTest.java:38-131):
+    AdaGrad-V + FTRL-W must reach avg logloss < 0.30; pure SGD < 0.60."""
+    from hivemall_tpu.models.ffm import train_ffm
+
+    rows, ys = [], []
+    with open(FFM_FILE) as f:
+        for line in f:
+            toks = line.split()
+            ys.append(1.0 if float(toks[0]) > 0 else -1.0)
+            rows.append(toks[1:])
+    ysa = np.asarray(ys)
+
+    def logloss_of(opts):
+        model = train_ffm(rows, ys, opts)
+        p = model.predict(rows)
+        return float(np.mean(np.logaddexp(0.0, -ysa * p)))
+
+    base = "-classification -factors 10 -w0 -seed 43 -iters 50 -disable_cv"
+    assert logloss_of(base) < 0.30  # reference AdaGrad-default gate
+    assert logloss_of(base + " -disable_adagrad -disable_ftrl") < 0.60  # SGD gate
