@@ -14,6 +14,72 @@ class VMRuntimeError(RuntimeError):
     pass
 
 
+# numeric op encoding shared with the native bulk evaluator
+# (native/hivemall_native.cpp hm_forest_eval)
+OP_PUSH_FEATURE = 0
+OP_PUSH_CONST = 1
+OP_POP = 2
+OP_GOTO = 3
+OP_IFEQ = 4
+OP_IFGE = 5
+OP_IFGT = 6
+OP_IFLE = 7
+OP_IFLT = 8
+OP_CALL_END = 9
+
+_IF_OPS = {"ifeq": OP_IFEQ, "ifeq2": OP_IFEQ, "ifge": OP_IFGE,
+           "ifgt": OP_IFGT, "ifle": OP_IFLE, "iflt": OP_IFLT}
+
+
+def compile_script_arrays(script):
+    """Lower a StackMachine script to flat (ops int8, argi int32, argf float64)
+    arrays for the native bulk evaluator. Same semantics as StackMachine.eval;
+    'last' jump targets resolve to the final op, 'end' pushes -1.0."""
+    import numpy as np
+
+    lines = script.split(StackMachine.SEP) if isinstance(script, str) \
+        else list(script)
+    n = len(lines)
+    ops = np.zeros(n, np.int8)
+    argi = np.zeros(n, np.int32)
+    argf = np.zeros(n, np.float64)
+
+    def target(operand: str) -> int:
+        if operand == "last":
+            return n - 1
+        return int(operand)
+
+    for k, line in enumerate(lines):
+        parts = line.split(" ")
+        op = parts[0].lower()
+        operand = parts[1] if len(parts) > 1 and parts[1] != "" else None
+        if op == "push":
+            if operand.startswith("x[") and operand.endswith("]"):
+                ops[k] = OP_PUSH_FEATURE
+                argi[k] = int(operand[2:-1])
+            elif operand == "end":
+                ops[k] = OP_PUSH_CONST
+                argf[k] = -1.0
+            else:
+                ops[k] = OP_PUSH_CONST
+                argf[k] = float(operand)
+        elif op == "pop":
+            ops[k] = OP_POP
+        elif op == "goto":
+            ops[k] = OP_GOTO
+            argi[k] = target(operand)
+        elif op in _IF_OPS:
+            ops[k] = _IF_OPS[op]
+            argi[k] = target(operand)
+        elif op == "call":
+            if operand != "end":
+                raise VMRuntimeError(f"unknown function {operand}")
+            ops[k] = OP_CALL_END
+        else:
+            raise VMRuntimeError(f"unknown op {op}")
+    return ops, argi, argf
+
+
 class StackMachine:
     SEP = "; "
 

@@ -57,6 +57,12 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.hm_zigzag_leb128_decode.argtypes = [
         ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
     ]
+    lib.hm_forest_eval.restype = ctypes.c_int64
+    lib.hm_forest_eval.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p,
+    ]
     _lib = lib
     return lib
 
@@ -209,3 +215,35 @@ def pack_block(idx_rows: Sequence[np.ndarray], val_rows: Sequence[np.ndarray],
         out_val.ctypes.data_as(ctypes.c_void_p),
         out_nnz.ctypes.data_as(ctypes.c_void_p))
     return out_idx, out_val, out_nnz
+
+
+def forest_eval(programs: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+                X: np.ndarray) -> Optional[np.ndarray]:
+    """Evaluate T compiled opcode programs (vm.compile_script_arrays output)
+    over X [N, F] raw rows -> [T, N] leaf values, or None without the
+    library. Raises on a malformed program."""
+    lib = _load()
+    if lib is None:
+        return None
+    T = len(programs)
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    N, F = X.shape
+    offsets = np.zeros(T + 1, np.int64)
+    for t, (ops, _, _) in enumerate(programs):
+        offsets[t + 1] = offsets[t] + len(ops)
+    ops = np.ascontiguousarray(np.concatenate([p[0] for p in programs]),
+                               dtype=np.int8)
+    argi = np.ascontiguousarray(np.concatenate([p[1] for p in programs]),
+                                dtype=np.int32)
+    argf = np.ascontiguousarray(np.concatenate([p[2] for p in programs]),
+                                dtype=np.float64)
+    out = np.empty((T, N), np.float64)
+    rc = lib.hm_forest_eval(
+        ops.ctypes.data_as(ctypes.c_void_p), argi.ctypes.data_as(ctypes.c_void_p),
+        argf.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p), T,
+        X.ctypes.data_as(ctypes.c_void_p), N, F,
+        out.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        raise ValueError("malformed opcode program")
+    return out

@@ -123,14 +123,28 @@ def ensemble_predict_rows(model_rows: Sequence[Tuple], X,
     if not model_rows:
         raise ValueError("no model rows to ensemble")
     X = np.asarray(X, dtype=np.float64)
-    evals = [compile_tree(row[1], row[2]) for row in model_rows]
-    out = np.empty(X.shape[0], dtype=np.float64)
-    for r in range(X.shape[0]):
-        votes = [ev(X[r]) for ev in evals]
-        if classification:
-            out[r] = rf_ensemble(int(v) for v in votes)[0]
-        else:
-            out[r] = float(np.mean(votes))
-    if classification and classes is not None:
-        return np.unique(np.asarray(classes))[out.astype(int)]
-    return out
+    leaf_vals = _eval_rows_native(model_rows, X)
+    if leaf_vals is None:  # mixed formats or no native library: Python VM
+        evals = [compile_tree(row[1], row[2]) for row in model_rows]
+        leaf_vals = np.stack([[ev(x) for x in X] for ev in evals])  # [T, N]
+    if classification:
+        out = np.array([rf_ensemble(int(v) for v in leaf_vals[:, r])[0]
+                        for r in range(X.shape[0])], dtype=np.float64)
+        if classes is not None:
+            return np.unique(np.asarray(classes))[out.astype(int)]
+        return out
+    return leaf_vals.mean(axis=0)
+
+
+def _eval_rows_native(model_rows: Sequence[Tuple], X) -> Optional[np.ndarray]:
+    """All-opcode row sets evaluate in ONE native pass (C++ hm_forest_eval
+    over the compiled programs) -> [T, N] leaf values, else None."""
+    if not all(row[1].lower() in ("opscode", "vm") for row in model_rows):
+        return None
+    from .. import native
+    from ..models.trees.vm import compile_script_arrays
+
+    if not native.available():
+        return None
+    progs = [compile_script_arrays(row[2]) for row in model_rows]
+    return native.forest_eval(progs, X)

@@ -290,4 +290,98 @@ int32_t hm_parse_int_feature(const uint8_t* s, int64_t len, int64_t* out_idx,
     return (end && *end == '\0') ? 0 : -1;
 }
 
+// --------------------------------------------------------- forest evaluator
+
+// Bulk StackMachine evaluation: T compiled opcode programs (the tree export
+// format, hivemall_tpu/models/trees/vm.py compile_script_arrays encoding)
+// over N rows of F raw features -> out[T*N] leaf values. Mirrors
+// StackMachine.eval exactly (comparisons pop (lower, upper), fall through
+// when `upper OP lower` holds; one-shot visit guard per op). Returns 0, or
+// -1 on a malformed program (bad feature index, stack misuse, loop).
+enum {
+    HM_OP_PUSH_FEATURE = 0,
+    HM_OP_PUSH_CONST = 1,
+    HM_OP_POP = 2,
+    HM_OP_GOTO = 3,
+    HM_OP_IFEQ = 4,
+    HM_OP_IFGE = 5,
+    HM_OP_IFGT = 6,
+    HM_OP_IFLE = 7,
+    HM_OP_IFLT = 8,
+    HM_OP_CALL_END = 9,
+};
+
+int64_t hm_forest_eval(const int8_t* ops, const int32_t* argi,
+                       const double* argf, const int64_t* offsets, int64_t T,
+                       const double* X, int64_t N, int64_t F, double* out) {
+    for (int64_t t = 0; t < T; t++) {
+        const int64_t base = offsets[t];
+        const int64_t n = offsets[t + 1] - base;
+        if (n <= 0) return -1;
+        for (int64_t r = 0; r < N; r++) {
+            const double* x = X + r * F;
+            double stack[64];
+            int sp = 0;
+            int64_t ip = 0, steps = 0;
+            double result = 0.0;
+            bool done = false;
+            while (ip >= 0 && ip < n) {
+                if (++steps > n) return -1;  // revisit = infinite loop
+                const int8_t op = ops[base + ip];
+                const int32_t ai = argi[base + ip];
+                switch (op) {
+                    case HM_OP_PUSH_FEATURE:
+                        if (ai < 0 || ai >= F || sp >= 64) return -1;
+                        stack[sp++] = x[ai];
+                        ip++;
+                        break;
+                    case HM_OP_PUSH_CONST:
+                        if (sp >= 64) return -1;
+                        stack[sp++] = argf[base + ip];
+                        ip++;
+                        break;
+                    case HM_OP_POP:
+                        if (sp < 1) return -1;
+                        result = stack[--sp];
+                        ip++;
+                        break;
+                    case HM_OP_GOTO:
+                        ip = ai;
+                        break;
+                    case HM_OP_IFEQ:
+                    case HM_OP_IFGE:
+                    case HM_OP_IFGT:
+                    case HM_OP_IFLE:
+                    case HM_OP_IFLT: {
+                        if (sp < 2) return -1;
+                        const double lower = stack[--sp];
+                        const double upper = stack[--sp];
+                        bool ok;
+                        switch (op) {
+                            case HM_OP_IFEQ: ok = upper == lower; break;
+                            case HM_OP_IFGE: ok = upper >= lower; break;
+                            case HM_OP_IFGT: ok = upper > lower; break;
+                            case HM_OP_IFLE: ok = upper <= lower; break;
+                            default: ok = upper < lower; break;
+                        }
+                        ip = ok ? ip + 1 : ai;
+                        break;
+                    }
+                    case HM_OP_CALL_END:
+                        if (sp < 1) return -1;
+                        result = stack[--sp];
+                        ip = n;  // halt
+                        done = true;
+                        break;
+                    default:
+                        return -1;
+                }
+            }
+            if (!done && steps == 0) return -1;
+            out[t * N + r] = result;
+        }
+    }
+    return 0;
+}
+
 }  // extern "C"

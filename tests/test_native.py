@@ -178,3 +178,44 @@ def test_encode_records_duplicate_ids_bit_identical():
     np.testing.assert_array_equal(indices[offsets[0]:offsets[1]], [3, 7, 7, 7])
     np.testing.assert_array_equal(values[offsets[0]:offsets[1]],
                                   [2.0, 9.0, 1.0, 5.0])
+
+
+def test_forest_eval_matches_stack_machine():
+    """Native bulk opcode evaluation must match the Python StackMachine on
+    every (tree, row) pair — numeric and nominal splits, classification and
+    regression leaves."""
+    from hivemall_tpu.models.trees.forest import (
+        train_randomforest_classifier, train_randomforest_regr)
+    from hivemall_tpu.models.trees.vm import StackMachine, compile_script_arrays
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(300, 5)
+    X[:, 2] = rng.randint(0, 4, 300)  # nominal column
+    y = ((X[:, 0] > 0.5) | (X[:, 2] == 1)).astype(int)
+    yr = (2.0 * X[:, 1] + X[:, 4]).astype(np.float32)
+    for forest in [
+        train_randomforest_classifier(X, y, "-trees 5 -depth 7 -seed 1 "
+                                      "-attrs Q,Q,C,Q,Q -output opscode"),
+        train_randomforest_regr(X, yr, "-trees 5 -depth 7 -seed 1 "
+                                "-attrs Q,Q,C,Q,Q -output opscode"),
+    ]:
+        scripts = [t.model for t in forest.trees]
+        progs = [compile_script_arrays(s) for s in scripts]
+        out = native.forest_eval(progs, X)
+        assert out.shape == (5, 300)
+        sm = StackMachine()
+        for t, s in enumerate(scripts):
+            sm.compile(s)
+            for r in range(0, 300, 7):
+                assert out[t, r] == sm.eval(X[r]), (t, r)
+
+
+def test_forest_eval_rejects_malformed():
+    import numpy as _np
+
+    # jump target out of range loops forever -> revisit guard trips
+    ops = _np.array([3], _np.int8)  # goto 0 (self)
+    argi = _np.array([0], _np.int32)
+    argf = _np.zeros(1, _np.float64)
+    with pytest.raises(ValueError):
+        native.forest_eval([(ops, argi, argf)], _np.zeros((2, 2)))
