@@ -2,7 +2,11 @@
 2^22-dim hashed model (the reference's headline workload shape — KDD2012
 Track 2 CTR-style sparse rows trained by train_arow, BASELINE.json).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — always.
+The parent process never imports jax (so a dead axon relay cannot hang it);
+the measurement runs in a child subprocess with a timeout. TPU is attempted
+twice, then the run falls back to CPU with the relay env scrubbed, and if
+everything fails the parent still emits a parseable zero-value line.
 
 Baseline anchor: the reference trains per-row on a JVM; a single Hive mapper
 sustains on the order of 2.5e5 AROW updates/sec (measured JVM hot-loop scale
@@ -11,15 +15,23 @@ numbers — BASELINE.md). vs_baseline = our rows/sec over that anchor.
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import numpy as np
 
 BASELINE_ROWS_PER_SEC = 250_000.0
 
+WIDTH = 32  # nnz per row, KDD CTR-ish
 
-def main() -> None:
+
+def _measure() -> None:
+    """Child body: run the benchmark on whatever backend jax lands on and
+    print the JSON line."""
+    import numpy as np
+
     import jax
+    import jax.numpy as jnp
 
     from hivemall_tpu.core.engine import make_train_step
     from hivemall_tpu.core.state import init_linear_state
@@ -28,7 +40,7 @@ def main() -> None:
     platform = jax.devices()[0].platform
     dims = 1 << 22
     batch = 16384
-    width = 32  # nnz per row, KDD CTR-ish
+    width = WIDTH
     n_blocks = 8
 
     rng = np.random.RandomState(0)
@@ -42,7 +54,6 @@ def main() -> None:
     # the reference likewise replays epochs from its in-memory/NIO buffer —
     # FactorizationMachineUDTF.java:521). Measured: the step itself is
     # transfer-free; see PERF.md for the staging-bandwidth analysis.
-    import jax.numpy as jnp
     idx_d = [jnp.asarray(idx[b]) for b in range(n_blocks)]
     val_d = [jnp.asarray(val[b]) for b in range(n_blocks)]
     lab_d = [jnp.asarray(lab[b]) for b in range(n_blocks)]
@@ -54,10 +65,10 @@ def main() -> None:
     state, loss = step(state, idx_d[0], val_d[0], lab_d[0])
     jax.block_until_ready(loss)
 
+    rounds = 40 if platform != "cpu" else 4
     t0 = time.perf_counter()
-    rounds = 40
     total_rows = 0
-    for r in range(rounds):
+    for _ in range(rounds):
         for b in range(n_blocks):
             state, loss = step(state, idx_d[b], val_d[b], lab_d[b])
             total_rows += batch
@@ -73,5 +84,60 @@ def main() -> None:
     }))
 
 
+def _run_child(env_overrides: dict, timeout: float):
+    """Run the child measurement; return its parsed JSON line or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env={**os.environ, **env_overrides},
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        print("bench child timed out", file=sys.stderr)
+        return None
+    except OSError as e:
+        print(f"bench child failed to launch: {e}", file=sys.stderr)
+        return None
+    if proc.returncode != 0:
+        # keep the one-JSON-line stdout contract; diagnostics go to stderr
+        sys.stderr.write(proc.stderr or "")
+        print(f"bench child exited rc={proc.returncode}", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return None
+
+
+def main() -> None:
+    # TPU attempt with the env as launched, one retry (transient relay
+    # hiccups), then CPU with the relay scrubbed so backend init cannot hang.
+    result = _run_child({}, timeout=360)
+    if result is None:
+        result = _run_child({}, timeout=240)
+    if result is None:
+        from hivemall_tpu.relay_env import SCRUB_ENV
+
+        result = _run_child(dict(SCRUB_ENV), timeout=900)
+    if result is None:
+        result = {
+            "metric": f"arow_train_throughput_2^22dims_{WIDTH}nnz_hbm_staged_none",
+            "value": 0.0,
+            "unit": "rows/sec",
+            "vs_baseline": 0.0,
+        }
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        _measure()
+    else:
+        main()

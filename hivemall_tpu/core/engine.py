@@ -112,6 +112,7 @@ def make_train_fn(
     mode: str = "minibatch",
     mini_batch_average: bool = True,
     track_deltas: bool = False,
+    feature_shard: Optional[Tuple[str, int]] = None,
 ):
     """Build the raw (unjitted) `step(state, indices, values, labels) ->
     (state, loss_sum)` — composable inside shard_map/scan by parallel/mix.py.
@@ -121,10 +122,44 @@ def make_train_fn(
     -mini_batch semantics). With `track_deltas`, state.slots[DELTA_SLOT]
     accumulates per-feature update counts (for delta-weighted model averaging,
     ref: PartialAverage.java:43-67).
+
+    `feature_shard=(axis_name, stripe)` runs the same step on a [D/stripe]
+    model stripe inside shard_map — the training analog of the reference's
+    feature-sharded parameter store (`hash(feature) mod numNodes` routing,
+    ref: mix/client/MixRequestRouter.java:56-60): lanes this device doesn't
+    own are masked out, per-row score/norm/variance partials psum over the
+    axis (so every device sees the global row scalars), and scatters land in
+    the local stripe only. Exact, not approximate: every rule's lane update
+    is a function of (global row scalars, lane-local state), which is what
+    the owning device computes.
     """
     if mode not in ("scan", "minibatch"):
         raise ValueError(f"unknown mode {mode!r}")
     use_cov = rule.use_covariance
+
+    if feature_shard is None:
+        def build_ctx(tables, idx, val, y, tf, gl):
+            return _row_ctx(tables, idx, val, y, tf, use_cov, gl), idx
+    else:
+        shard_axis, stripe = feature_shard
+
+        def build_ctx(tables, idx, val, y, tf, gl):
+            dev = jax.lax.axis_index(shard_axis)
+            local_idx = idx - dev * stripe
+            owned = (local_idx >= 0) & (local_idx < stripe)
+            # non-owned lanes route to the one-past-end drop slot
+            local_idx = jnp.where(owned, local_idx, stripe)
+            vmask = val * owned.astype(val.dtype)
+            # same gathers/row scalars as the local path, on the stripe's
+            # lanes only — then the scalar partials psum to global values
+            ctx = _row_ctx(tables, local_idx, vmask, y, tf, use_cov, gl)
+            ctx = ctx.replace(
+                score=jax.lax.psum(ctx.score, shard_axis),
+                sq_norm=jax.lax.psum(ctx.sq_norm, shard_axis),
+                variance=jax.lax.psum(ctx.variance, shard_axis)
+                if use_cov else ctx.variance,
+            )
+            return ctx, local_idx
 
     def scan_step(state: LinearState, indices, values, labels):
         def body(carry, row):
@@ -133,25 +168,25 @@ def make_train_fn(
             tf = (t + 1).astype(jnp.float32)
             if rule.pre_row is not None:
                 gl = rule.pre_row(gl, y)
-            ctx = _row_ctx((weights, covars, slots), idx, val, y, tf, use_cov, gl)
+            ctx, sidx = build_ctx((weights, covars, slots), idx, val, y, tf, gl)
             out = rule.update(ctx, hyper)
-            weights = weights.at[idx].add(out.dw, mode="drop")
+            weights = weights.at[sidx].add(out.dw, mode="drop")
             if use_cov and out.dcov is not None:
-                covars = covars.at[idx].add(out.dcov, mode="drop")
+                covars = covars.at[sidx].add(out.dcov, mode="drop")
             new_slots = dict(slots)
             for k, d in out.dslots.items():
-                new_slots[k] = slots[k].at[idx].add(d, mode="drop")
+                new_slots[k] = slots[k].at[sidx].add(d, mode="drop")
             if rule.derive_w is not None:
                 # lane-wise slot values after this row's delta
                 sl_new = {k: ctx.slots[k] + out.dslots.get(k, 0.0) for k in slots}
                 w_new = rule.derive_w(sl_new, tf, hyper)
                 w_new = jnp.where(out.updated, w_new, ctx.w)
-                weights = weights.at[idx].set(w_new, mode="drop")
+                weights = weights.at[sidx].set(w_new, mode="drop")
             upd = out.updated.astype(jnp.int8)
-            touched = touched.at[idx].max(jnp.broadcast_to(upd, idx.shape), mode="drop")
+            touched = touched.at[sidx].max(jnp.broadcast_to(upd, sidx.shape), mode="drop")
             if track_deltas:
-                new_slots[DELTA_SLOT] = slots[DELTA_SLOT].at[idx].add(
-                    jnp.broadcast_to(out.updated.astype(jnp.float32), idx.shape),
+                new_slots[DELTA_SLOT] = slots[DELTA_SLOT].at[sidx].add(
+                    jnp.broadcast_to(out.updated.astype(jnp.float32), sidx.shape),
                     mode="drop")
             return (weights, covars, new_slots, touched, t + 1, gl), out.loss
 
@@ -175,11 +210,11 @@ def make_train_fn(
             gl = rule.pre_batch(gl, labels)
 
         def per_row(idx, val, y, tf):
-            ctx = _row_ctx((state.weights, state.covars, state.slots), idx, val, y, tf,
-                           use_cov, gl)
-            return rule.update(ctx, hyper), ctx
+            ctx, sidx = build_ctx((state.weights, state.covars, state.slots),
+                                  idx, val, y, tf, gl)
+            return rule.update(ctx, hyper), sidx
 
-        outs, ctxs = jax.vmap(per_row)(indices, values, labels, ts)
+        outs, sidx = jax.vmap(per_row)(indices, values, labels, ts)
         upd = outs.updated.astype(jnp.float32)  # [B]
         lane_upd = upd[:, None] * jnp.ones_like(values)  # [B, K]
 
@@ -187,37 +222,37 @@ def make_train_fn(
         if mini_batch_average:
             # Per-feature averaged application, exactly the reference's
             # FloatAccumulator semantics (RegressionBaseUDTF.java:236-295).
-            counts = jnp.zeros_like(weights).at[indices].add(lane_upd, mode="drop")
+            counts = jnp.zeros_like(weights).at[sidx].add(lane_upd, mode="drop")
             denom = jnp.maximum(counts, 1.0)
-            dw_sum = jnp.zeros_like(weights).at[indices].add(outs.dw, mode="drop")
+            dw_sum = jnp.zeros_like(weights).at[sidx].add(outs.dw, mode="drop")
             weights = weights + dw_sum / denom
             if use_cov and outs.dcov is not None:
-                dc_sum = jnp.zeros_like(covars).at[indices].add(outs.dcov, mode="drop")
+                dc_sum = jnp.zeros_like(covars).at[sidx].add(outs.dcov, mode="drop")
                 covars = covars + dc_sum / denom
         else:
-            weights = weights.at[indices].add(outs.dw, mode="drop")
+            weights = weights.at[sidx].add(outs.dw, mode="drop")
             if use_cov and outs.dcov is not None:
-                covars = covars.at[indices].add(outs.dcov, mode="drop")
+                covars = covars.at[sidx].add(outs.dcov, mode="drop")
         new_slots = dict(slots)
         for k in rule.slot_names:
             if k in outs.dslots:
-                new_slots[k] = slots[k].at[indices].add(outs.dslots[k], mode="drop")
+                new_slots[k] = slots[k].at[sidx].add(outs.dslots[k], mode="drop")
         if rule.derive_w is not None:
             # Dual-averaging weights are a pure function of the *updated*
             # accumulators — gather-after-scatter makes duplicate features
             # across the batch deterministic.
             tf_end = (t0 + b).astype(jnp.float32)
-            sl_g = {k: _gather(new_slots[k], indices) for k in new_slots}
+            sl_g = {k: _gather(new_slots[k], sidx) for k in new_slots}
             w_new = rule.derive_w(sl_g, tf_end, hyper)  # [B, K]
-            keep = _gather(weights, indices)
+            keep = _gather(weights, sidx)
             w_new = jnp.where(lane_upd > 0, w_new, keep)
-            weights = weights.at[indices].set(w_new, mode="drop")
-        touched = state.touched.at[indices].max(
+            weights = weights.at[sidx].set(w_new, mode="drop")
+        touched = state.touched.at[sidx].max(
             lane_upd.astype(jnp.int8), mode="drop"
         )
         if track_deltas:
             new_slots[DELTA_SLOT] = new_slots.get(DELTA_SLOT, state.slots[DELTA_SLOT]) \
-                .at[indices].add(lane_upd, mode="drop")
+                .at[sidx].add(lane_upd, mode="drop")
         new_state = state.replace(
             weights=weights,
             covars=covars,

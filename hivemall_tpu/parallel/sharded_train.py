@@ -1,0 +1,110 @@
+"""Feature-dimension sharded TRAINING — model-parallel linear learners.
+
+The reference trains against a parameter store sharded across MIX servers by
+feature hash: every update routes to `hash(feature) mod numNodes`
+(ref: mix/client/MixRequestRouter.java:56-60), so no single node holds the
+whole 2^24-dim model. TPU-native, the same capability is the model pytree
+sharded along the feature dimension over the mesh: each device holds a [D/n]
+stripe of weights / covars / optimizer slots, and a training step is
+
+    gather:  each device gathers its stripe's hits (lanes it does not own are
+             masked to zero),
+    reduce:  per-row score / squared-norm / variance partials psum over ICI —
+             after the psum every device knows the full-row scalars,
+    update:  the rule's closed form runs lane-wise on every device with the
+             *global* scalars, and deltas scatter into the local stripe only.
+
+The step body is the ordinary engine step built with
+`make_train_fn(..., feature_shard=(axis, stripe))` (core/engine.py) — one
+copy of the update-application logic, sharded or not. Parity vs the
+single-device engine is exact up to psum summation order
+(tests/test_sharded_train.py).
+
+Unlike the data-parallel MixTrainer (full replica per device, periodic
+averaging), this path trains ONE model too big for one chip's HBM — e.g.
+covariance + optimizer slots at 2^24+ dims — the TP analog this workload
+admits (SURVEY.md §2.18 "feature-sharded servers → model-dim sharding").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..core.engine import Rule, make_train_fn
+from ..core.state import LinearState, init_linear_state
+from .mesh import make_mesh
+
+
+class ShardedTrainer:
+    """Train a single feature-sharded model across the mesh.
+
+    The state returned by `init()` / threaded through `step()` is a full-dims
+    LinearState whose [D] leaves carry a NamedSharding along the feature dim —
+    each device materializes only its [D/n] stripe in HBM. Blocks are
+    replicated (every device sees every row; the model, not the data, is what
+    doesn't fit).
+    """
+
+    def __init__(self, rule: Rule, hyper: dict, dims: int,
+                 mesh: Optional[Mesh] = None, mode: str = "minibatch",
+                 mini_batch_average: bool = True):
+        self.rule = rule
+        self.hyper = hyper
+        self.dims = dims
+        self.mesh = mesh if mesh is not None else make_mesh()
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError(
+                f"ShardedTrainer needs a 1-D mesh, got axes {self.mesh.axis_names}")
+        self.axis = self.mesh.axis_names[0]
+        n = self.mesh.devices.size
+        if dims % n != 0:
+            raise ValueError(f"dims {dims} not divisible by {n} devices")
+        self.stripe = dims // n
+
+        body_fn = make_train_fn(rule, hyper, mode=mode,
+                                mini_batch_average=mini_batch_average,
+                                feature_shard=(self.axis, self.stripe))
+        state_shape = jax.eval_shape(self._init_one)
+        # [D] leaves stripe along the feature dim; scalars replicate
+        specs = jax.tree.map(
+            lambda leaf: P(self.axis) if leaf.ndim == 1 else P(), state_shape)
+        self._specs = specs
+        self._step = jax.jit(
+            jax.shard_map(
+                body_fn,
+                mesh=self.mesh,
+                in_specs=(specs, P(), P(), P()),
+                out_specs=(specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def _init_one(self, **kwargs) -> LinearState:
+        return init_linear_state(
+            self.dims,
+            use_covariance=self.rule.use_covariance,
+            slot_names=tuple(self.rule.slot_names),
+            global_names=self.rule.global_names,
+            **kwargs,
+        )
+
+    def init(self, **kwargs) -> LinearState:
+        """Initial state with [D] leaves placed feature-sharded on the mesh —
+        each device allocates only its stripe. kwargs pass through to
+        init_linear_state (initial_weights/initial_covars = -loadmodel warm
+        start, ref: LearnerBaseUDTF.java:215-333)."""
+        state = self._init_one(**kwargs)
+        return jax.tree.map(
+            lambda leaf, spec: jax.device_put(
+                leaf, NamedSharding(self.mesh, spec)),
+            state, self._specs)
+
+    def step(self, state: LinearState, indices, values, labels):
+        """One sharded train step. indices/values: [B, K]; labels: [B]
+        (replicated to every device — the model is what's sharded)."""
+        return self._step(state, indices, values, labels)

@@ -1,0 +1,11 @@
+"""The axon-TPU-relay scrub used by every CPU-only entry point.
+
+With the relay down, dialing it during jax backend init hangs the process
+(round-1 rc=124). Entry points that are CPU-by-definition (the multichip
+dryrun, the test suite, bench's CPU fallback) apply this env before jax's
+backend initializes. Kept jax-import-free so bench.py's parent process can
+import it without risking the very hang it guards against; scripts/test.sh
+encodes the same recipe in shell.
+"""
+
+SCRUB_ENV = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}

@@ -1,0 +1,133 @@
+"""Feature-dim sharded TRAINING parity on the simulated 8-device CPU mesh.
+
+The capability under test is the training analog of the reference's
+feature-sharded parameter store (`hash(feature) mod numNodes` routing,
+ref: mix/client/MixRequestRouter.java:56-60): one model too big for a single
+device, its [D] leaves striped across the mesh, trained to parity with the
+single-device engine.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from hivemall_tpu.core.engine import make_train_step
+from hivemall_tpu.core.state import init_linear_state, model_rows
+from hivemall_tpu.models.classifier import ADAGRAD_RDA, AROW, PERCEPTRON
+from hivemall_tpu.models.regression import ADAGRAD_REGR
+from hivemall_tpu.parallel import make_mesh
+from hivemall_tpu.parallel.sharded_train import ShardedTrainer
+
+N_DEV = 8
+
+
+def _gen_blocks(dims, n_blocks, batch, width, seed=0):
+    rng = np.random.RandomState(seed)
+    idx = rng.randint(0, dims, size=(n_blocks, batch, width)).astype(np.int32)
+    val = rng.rand(n_blocks, batch, width).astype(np.float32)
+    lab = np.sign(rng.randn(n_blocks, batch)).astype(np.float32)
+    return idx, val, lab
+
+
+def _reference_state(rule, hyper, dims, blocks, mode):
+    step = make_train_step(rule, hyper, mode=mode, donate=False)
+    state = init_linear_state(
+        dims, use_covariance=rule.use_covariance,
+        slot_names=tuple(rule.slot_names), global_names=rule.global_names)
+    for i in range(blocks[0].shape[0]):
+        state, loss = step(state, blocks[0][i], blocks[1][i], blocks[2][i])
+    return jax.device_get(state), float(loss)
+
+
+def _sharded_state(rule, hyper, dims, blocks, mode):
+    trainer = ShardedTrainer(rule, hyper, dims, make_mesh(N_DEV), mode=mode)
+    state = trainer.init()
+    for i in range(blocks[0].shape[0]):
+        state, loss = trainer.step(state, blocks[0][i], blocks[1][i],
+                                   blocks[2][i])
+    return jax.device_get(state), float(loss)
+
+
+@pytest.mark.parametrize("mode", ["minibatch", "scan"])
+def test_arow_sharded_parity(mode):
+    """Covariance learner: weights AND covars match the single-device engine."""
+    dims = 1 << 10
+    blocks = _gen_blocks(dims, n_blocks=4, batch=32, width=8)
+    ref, ref_loss = _reference_state(AROW, {"r": 0.1}, dims, blocks, mode)
+    got, got_loss = _sharded_state(AROW, {"r": 0.1}, dims, blocks, mode)
+    np.testing.assert_allclose(got.weights, ref.weights, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(got.covars, ref.covars, rtol=2e-5, atol=1e-6)
+    np.testing.assert_array_equal(got.touched, ref.touched)
+    assert got_loss == pytest.approx(ref_loss, rel=1e-4)
+
+
+@pytest.mark.parametrize("mode", ["minibatch", "scan"])
+def test_perceptron_sharded_parity(mode):
+    dims = 1 << 10
+    blocks = _gen_blocks(dims, n_blocks=3, batch=16, width=8, seed=1)
+    ref, _ = _reference_state(PERCEPTRON, {}, dims, blocks, mode)
+    got, _ = _sharded_state(PERCEPTRON, {}, dims, blocks, mode)
+    np.testing.assert_allclose(got.weights, ref.weights, rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["minibatch", "scan"])
+def test_adagrad_rda_sharded_parity(mode):
+    """Dual-averaging (derive_w) rule: slots and derived weights match."""
+    dims = 1 << 10
+    blocks = _gen_blocks(dims, n_blocks=3, batch=16, width=8, seed=2)
+    hyper = {"eta": 0.1, "lambda": 1e-6, "scale": 100.0}
+    ref, _ = _reference_state(ADAGRAD_RDA, hyper, dims, blocks, mode)
+    got, _ = _sharded_state(ADAGRAD_RDA, hyper, dims, blocks, mode)
+    np.testing.assert_allclose(got.weights, ref.weights, rtol=2e-5, atol=1e-6)
+    for k in ref.slots:
+        np.testing.assert_allclose(got.slots[k], ref.slots[k],
+                                   rtol=2e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["minibatch"])
+def test_regressor_with_slots_sharded_parity(mode):
+    dims = 1 << 10
+    rng = np.random.RandomState(3)
+    idx = rng.randint(0, dims, size=(3, 16, 8)).astype(np.int32)
+    val = rng.rand(3, 16, 8).astype(np.float32)
+    lab = rng.rand(3, 16).astype(np.float32)  # regression targets in [0,1]
+    blocks = (idx, val, lab)
+    hyper = {"eta": 1.0, "eps": 1.0, "scale": 100.0}
+    ref, _ = _reference_state(ADAGRAD_REGR, hyper, dims, blocks, mode)
+    got, _ = _sharded_state(ADAGRAD_REGR, hyper, dims, blocks, mode)
+    np.testing.assert_allclose(got.weights, ref.weights, rtol=2e-5, atol=1e-6)
+
+
+def test_big_model_2pow20_covariance_sharded():
+    """The capability claim: a 2^20-dim covariance model trains sharded —
+    each device materializes a 2^17 stripe — with exact engine parity and a
+    working model dump."""
+    dims = 1 << 20
+    blocks = _gen_blocks(dims, n_blocks=2, batch=64, width=16, seed=4)
+    ref, _ = _reference_state(AROW, {"r": 0.1}, dims, blocks, "minibatch")
+    trainer = ShardedTrainer(AROW, {"r": 0.1}, dims, make_mesh(N_DEV))
+    state = trainer.init()
+    # every [D] leaf is laid out feature-sharded over the mesh
+    assert state.weights.sharding.spec[0] is not None
+    assert state.weights.sharding.shard_shape(state.weights.shape)[0] \
+        == dims // N_DEV
+    for i in range(blocks[0].shape[0]):
+        state, _ = trainer.step(state, blocks[0][i], blocks[1][i], blocks[2][i])
+    got = jax.device_get(state)
+    np.testing.assert_allclose(got.weights, ref.weights, rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(got.covars, ref.covars, rtol=2e-5, atol=1e-6)
+    # model emission over touched entries works off the sharded state
+    feats, w, cov = model_rows(got)
+    rfeats, rw, rcov = model_rows(ref)
+    np.testing.assert_array_equal(feats, rfeats)
+    np.testing.assert_allclose(w, rw, rtol=2e-5, atol=1e-6)
+
+
+def test_warm_start_sharded():
+    """-loadmodel analog: initial weights land in the right stripes."""
+    dims = 1 << 10
+    init_w = np.zeros(dims, dtype=np.float32)
+    init_w[::97] = 1.5
+    trainer = ShardedTrainer(PERCEPTRON, {}, dims, make_mesh(N_DEV))
+    state = trainer.init(initial_weights=init_w)
+    np.testing.assert_allclose(jax.device_get(state.weights), init_w)
