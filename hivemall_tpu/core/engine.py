@@ -85,6 +85,13 @@ class Rule:
     pre_batch: Optional[Callable] = None
     # loss used for convergence accounting only
     is_regression: bool = False
+    # How each optimizer slot merges across data-parallel replicas when a
+    # mixed model is collapsed to one (MixTrainer.final_state): "sum" for
+    # additive per-example statistics (AdaGrad G accumulators — replicas saw
+    # disjoint shards, so the union stream's sum is the sum of per-shard
+    # sums), "mean" for decayed/EMA statistics (AdaDelta). Unlisted slots
+    # default to "mean" over the replicas that touched the feature.
+    slot_merge: Tuple[Tuple[str, str], ...] = ()
 
 
 def _gather(table: jnp.ndarray, idx: jnp.ndarray, fill: float = 0.0) -> jnp.ndarray:

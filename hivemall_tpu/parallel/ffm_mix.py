@@ -42,11 +42,22 @@ class FFMMixTrainer:
             st, losses = jax.lax.scan(body, st, blocks)
             counts = st.touched.astype(jnp.float32)
             total = jax.lax.psum(counts, self.axis)
-            w = jnp.where(total > 0,
-                          jax.lax.psum(st.w * counts, self.axis)
-                          / jnp.maximum(total, 1.0), st.w)
+
+            def touch_avg(x):
+                return jnp.where(total > 0,
+                                 jax.lax.psum(x * counts, self.axis)
+                                 / jnp.maximum(total, 1.0), x)
+
+            # FTRL derives w from the duals at the next update of a feature
+            # (w_updates in models/ffm.py), so mixing w alone would be
+            # overwritten — the duals z/n mix with the same touch-weighted
+            # average, keeping the mixed linear term effective. w is mixed
+            # too: it is read directly by predict for features not updated
+            # again.
             st = st.replace(
-                w=w,
+                w=touch_avg(st.w),
+                z=touch_avg(st.z),
+                n=touch_avg(st.n),
                 v=jax.lax.pmean(st.v, self.axis),
                 w0=jax.lax.pmean(st.w0, self.axis),
             )
@@ -77,6 +88,17 @@ class FFMMixTrainer:
         return self._step(state, indices, values, fields, labels)
 
     def final_state(self, state) -> FFMState:
+        """Collapse the device axis: w/z/n/v/w0 are identical across replicas
+        after the trailing mix; touched unions; the AdaGrad-V accumulator
+        v_gg — an additive sum of squared gradients over each replica's
+        disjoint shard — merges by summing (the union stream's total), so a
+        warm restart resumes with the full-stream curvature instead of one
+        replica's."""
         host = jax.device_get(state)
         merged = jax.tree.map(lambda x: x[0], host)
-        return merged.replace(touched=np.max(np.asarray(host.touched), axis=0))
+        step_all = np.asarray(host.step)
+        return merged.replace(
+            touched=np.max(np.asarray(host.touched), axis=0),
+            v_gg=np.asarray(host.v_gg).sum(axis=0),
+            step=step_all.sum().astype(step_all.dtype),
+        )

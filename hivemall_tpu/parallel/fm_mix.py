@@ -93,6 +93,17 @@ class FMMixTrainer:
         return self._step(state, indices, values, labels, va)
 
     def final_state(self, state: FMState) -> FMState:
+        """Collapse the device axis: w0/w/v are identical across replicas
+        after the trailing mix; touched unions; the adaptive-regularization
+        lambdas (data-derived scalars, ref: FactorizationMachineModel
+        updateLambda* :253-300) average across replicas."""
         host = jax.device_get(state)
         merged = jax.tree.map(lambda x: x[0], host)
-        return merged.replace(touched=np.max(np.asarray(host.touched), axis=0))
+        step_all = np.asarray(host.step)
+        return merged.replace(
+            touched=np.max(np.asarray(host.touched), axis=0),
+            lambda_w0=np.asarray(host.lambda_w0).mean(axis=0),
+            lambda_w=np.asarray(host.lambda_w).mean(axis=0),
+            lambda_v=np.asarray(host.lambda_v).mean(axis=0),
+            step=step_all.sum().astype(step_all.dtype),
+        )

@@ -101,4 +101,8 @@ class MulticlassMixTrainer:
     def final_state(self, state) -> MulticlassState:
         host = jax.device_get(state)
         merged = jax.tree.map(lambda x: x[0], host)
-        return merged.replace(touched=np.max(np.asarray(host.touched), axis=0))
+        step_all = np.asarray(host.step)
+        return merged.replace(
+            touched=np.max(np.asarray(host.touched), axis=0),
+            step=step_all.sum().astype(step_all.dtype),
+        )

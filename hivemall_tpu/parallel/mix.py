@@ -68,7 +68,13 @@ def mix_argmin_kld(weights, covars, delta_upd, axis_name: str = WORKER_AXIS):
 
 @dataclass(frozen=True)
 class MixConfig:
-    mix_every: int = 1  # mix after this many blocks (clock/sync analog)
+    # Mix after this many blocks — the sync-threshold analog: the reference's
+    # server replies with the global average only when a feature's clock
+    # advanced >= syncThreshold since the last reply
+    # (ref: mixserv/.../MixServerHandler.java:142-148). Each step() call's
+    # per-device blocks are consumed in groups of `mix_every`, with one
+    # collective mix after each group.
+    mix_every: int = 1
     reduction: str = "auto"  # average | argmin_kld | auto (covariance -> argmin_kld,
     # mirroring the reference's event selection for covariance learners)
     axis_name: str = WORKER_AXIS
@@ -96,17 +102,9 @@ class MixTrainer:
 
         local_fn = make_train_fn(rule, hyper, mode=mode, track_deltas=True)
 
-        def device_step(state: LinearState, indices, values, labels):
-            # state leaves carry a leading [1] device axis inside shard_map
-            st = jax.tree.map(lambda x: x[0], state)
-            blocks = (indices[0], values[0], labels[0])  # [k, B, ...]
+        mix_every = config.mix_every
 
-            def body(s, blk):
-                s, loss = local_fn(s, *blk)
-                return s, loss
-
-            st, losses = jax.lax.scan(body, st, blocks)
-            # ---- mix ----
+        def mix(st: LinearState) -> LinearState:
             delta = st.slots[DELTA_SLOT]
             if self.reduction == "argmin_kld":
                 w, cov, _ = mix_argmin_kld(st.weights, st.covars, delta, axis)
@@ -114,7 +112,30 @@ class MixTrainer:
             else:
                 w, _ = mix_average(st.weights, delta, axis)
                 st = st.replace(weights=w)
-            st = st.replace(slots={**st.slots, DELTA_SLOT: jnp.zeros_like(delta)})
+            return st.replace(slots={**st.slots, DELTA_SLOT: jnp.zeros_like(delta)})
+
+        def device_step(state: LinearState, indices, values, labels):
+            # state leaves carry a leading [1] device axis inside shard_map
+            st = jax.tree.map(lambda x: x[0], state)
+            k = indices.shape[1]
+            if k % mix_every != 0:
+                raise ValueError(
+                    f"{k} blocks per device not divisible by mix_every={mix_every}")
+            # [k, B, ...] -> [k/mix_every, mix_every, B, ...]: train a group
+            # locally, then one collective mix per group
+            groups = jax.tree.map(
+                lambda a: a.reshape((k // mix_every, mix_every) + a.shape[1:]),
+                (indices[0], values[0], labels[0]))
+
+            def group_body(s, grp):
+                def body(s, blk):
+                    s, loss = local_fn(s, *blk)
+                    return s, loss
+
+                s, losses = jax.lax.scan(body, s, grp)
+                return mix(s), jnp.sum(losses)
+
+            st, losses = jax.lax.scan(group_body, st, groups)
             loss_sum = jax.lax.psum(jnp.sum(losses), axis)
             return jax.tree.map(lambda x: x[None], st), loss_sum
 
@@ -169,9 +190,57 @@ class MixTrainer:
         return reshape(indices), reshape(values), reshape(labels)
 
     def final_state(self, state: LinearState) -> LinearState:
-        """Collapse the device axis after the trailing mix: weights/covars are
-        identical across replicas; touched/delta merge by max/sum."""
+        """Collapse the device axis after the trailing mix into one model a
+        warm restart can resume from (the mixed analog of -loadmodel,
+        ref: LearnerBaseUDTF.java:215-333).
+
+        - weights/covars: identical across replicas after the trailing mix —
+          replica 0's copy IS the mixed model;
+        - touched: max (union of features any replica updated);
+        - optimizer slots: merged per the rule's declared kind over the
+          replicas that touched each feature — "sum" for additive statistics,
+          "mean" (the default) for decayed ones (Rule.slot_merge); the delta
+          counter resets (nothing is pending after the trailing mix);
+        - Welford globals (n, mean, m2): exact Chan parallel merge across the
+          replicas' disjoint shards (ref: common/OnlineVariance.java); other
+          globals keep replica 0's value.
+        """
         host = jax.device_get(state)
         merged = jax.tree.map(lambda x: x[0], host)
-        merged = merged.replace(touched=np.max(np.asarray(host.touched), axis=0))
+        touched_all = np.asarray(host.touched)  # [n_dev, D] int8
+        merged = merged.replace(touched=np.max(touched_all, axis=0))
+
+        if host.slots:
+            kinds = dict(self.rule.slot_merge)
+            tmask = touched_all.astype(np.float32)
+            n_touch = np.maximum(tmask.sum(axis=0), 1.0)
+            new_slots = {}
+            for name, arr in host.slots.items():
+                arr = np.asarray(arr)  # [n_dev, D]
+                if name == DELTA_SLOT:
+                    new_slots[name] = np.zeros_like(arr[0])
+                    continue
+                total = (arr * tmask).sum(axis=0)
+                if kinds.get(name, "mean") == "sum":
+                    new_slots[name] = total
+                else:
+                    new_slots[name] = total / n_touch
+            merged = merged.replace(slots=new_slots)
+
+        gl = {k: np.asarray(v) for k, v in host.globals.items()}  # [n_dev] each
+        if {"n", "mean", "m2"} <= set(gl):
+            n = gl["n"].astype(np.float64)
+            tot = n.sum()
+            if tot > 0:
+                mean = float((gl["mean"] * n).sum() / tot)
+                m2 = float(gl["m2"].sum()
+                           + (n * (gl["mean"] - mean) ** 2).sum())
+                merged = merged.replace(globals={
+                    **merged.globals,
+                    "n": np.float32(tot),
+                    "mean": np.float32(mean),
+                    "m2": np.float32(m2),
+                })
+        step_all = np.asarray(host.step)
+        merged = merged.replace(step=step_all.sum().astype(step_all.dtype))
         return merged
