@@ -114,9 +114,20 @@ def _dloss_and_loss(p, y, hyper: FMHyper):
     return g, loss
 
 
-def make_fm_step(hyper: FMHyper, mode: str = "minibatch"):
+def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
+                 mini_batch_average: bool = True):
     """Jitted FM block update. scan = reference-exact sequential; minibatch =
-    accumulate-then-apply against block-start parameters."""
+    accumulate-then-apply against block-start parameters.
+
+    `mini_batch_average` applies each parameter's accumulated delta divided by
+    its update count — w/V per-feature touch counts, w0 by the batch size —
+    exactly the reference's own mini-batch application rule (sum/count,
+    ref: RegressionBaseUDTF.java:281-295 + utils/lang/FloatAccumulator.java:38-41;
+    the reference FM itself is per-row-only, so averaging is the documented
+    bridge semantic, same as core/engine.py's minibatch mode). Without it the
+    raw sums scale the effective step by the per-feature row frequency and
+    diverge at CTR batch sizes/head features.
+    """
 
     def row_deltas(state: FMState, idx, val, y, t):
         eta = hyper.eta.eta(t)
@@ -182,10 +193,28 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch"):
         dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta = jax.vmap(per_row)(
             indices, values, labels, ts)
         theta = (1.0 - va_mask)  # [B]
+        if mini_batch_average:
+            # per-feature counts, then gather each lane's own denominator and
+            # scatter the pre-divided deltas straight into the donated tables
+            # — no full-[D] or full-[D,k] delta temporaries on the hot path
+            counts = jnp.zeros((state.w.shape[0],), jnp.float32).at[indices].add(
+                jnp.broadcast_to(theta[:, None], indices.shape), mode="drop")
+            denom_lanes = jnp.maximum(
+                counts.at[indices].get(mode="fill", fill_value=1.0), 1.0)
+            new_w = state.w.at[indices].add(
+                theta[:, None] * dw / denom_lanes, mode="drop")
+            new_v = state.v.at[indices].add(
+                theta[:, None, None] * dv / denom_lanes[:, :, None], mode="drop")
+            new_w0 = state.w0 + jnp.sum(theta * dw0) / jnp.maximum(
+                jnp.sum(theta), 1.0)
+        else:
+            new_w = state.w.at[indices].add(theta[:, None] * dw, mode="drop")
+            new_v = state.v.at[indices].add(theta[:, None, None] * dv, mode="drop")
+            new_w0 = state.w0 + jnp.sum(theta * dw0)
         new_state = state.replace(
-            w0=state.w0 + jnp.sum(theta * dw0),
-            w=state.w.at[indices].add(theta[:, None] * dw, mode="drop"),
-            v=state.v.at[indices].add(theta[:, None, None] * dv, mode="drop"),
+            w0=new_w0,
+            w=new_w,
+            v=new_v,
             touched=state.touched.at[indices].max(
                 jnp.broadcast_to((theta > 0).astype(jnp.int8)[:, None], indices.shape),
                 mode="drop"),

@@ -31,7 +31,7 @@ Update rules mirror (file:line cited in each rule):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Callable, List, Optional, Sequence, Tuple, Union
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,13 @@ class MulticlassState:
     covars: Optional[jnp.ndarray]  # [L, D] init 1.0
     touched: jnp.ndarray  # [L, D] int8
     step: jnp.ndarray  # [] int32
+    # optimizer aux, [L, D] per name — empty for every current rule (the
+    # reference's multiclass learners are all closed-form alpha/beta with no
+    # accumulator state). mc_mix.final_state merges these per
+    # MCRule.slot_merge so a distributed collapse can never silently keep
+    # replica 0's accumulators; a slotted rule would additionally need
+    # init/update plumbing here and in make_mc_train_step.
+    slots: Dict[str, jnp.ndarray] = struct.field(default_factory=dict)
 
 
 @dataclass(frozen=True)
@@ -63,6 +70,10 @@ class MCRule:
     name: str
     compute: Callable  # (m, var, sq_norm, hyper) -> (alpha, beta, loss, updated)
     cov_kind: str = "none"
+    # (slot_name, "sum"|"mean") merge kinds for distributed final_state —
+    # same contract as core.engine.Rule.slot_merge; empty for every current
+    # rule (no multiclass rule carries accumulator slots)
+    slot_merge: Tuple[Tuple[str, str], ...] = ()
 
     @property
     def use_covariance(self) -> bool:

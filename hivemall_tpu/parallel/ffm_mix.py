@@ -6,6 +6,9 @@ Same contract as fm_mix.py: replicas train on shards, weights cross the
 has no per-entry touch mask; entries untouched everywhere are identical
 across replicas so the mean is a no-op for them). FTRL z/n and AdaGrad gg
 stay device-local.
+
+Mix cadence is MixConfig.mix_every, uniform with MixTrainer: the default (1)
+mixes after every block; mix_every=k trains k blocks locally per collective.
 """
 
 from __future__ import annotations
@@ -20,26 +23,20 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.ffm import FFMHyper, FFMState, init_ffm_state, make_ffm_step
 from .mesh import WORKER_AXIS, make_mesh
+from .mix import MixConfig, grouped_mix_scan
 
 
 class FFMMixTrainer:
     def __init__(self, hyper: FFMHyper, mesh: Optional[Mesh] = None,
-                 mode: str = "minibatch", axis_name: str = WORKER_AXIS):
+                 mode: str = "minibatch", config: MixConfig = MixConfig()):
         self.hyper = hyper
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_dev = self.mesh.devices.size
-        self.axis = axis_name
+        self.config = config
+        self.axis = config.axis_name
         local_step = make_ffm_step(hyper, mode)
 
-        def device_step(state: FFMState, indices, values, fields, labels):
-            st = jax.tree.map(lambda x: x[0], state)
-            blocks = (indices[0], values[0], fields[0], labels[0])
-
-            def body(s, blk):
-                s, loss = local_step(s, *blk)
-                return s, loss
-
-            st, losses = jax.lax.scan(body, st, blocks)
+        def mix(st: FFMState) -> FFMState:
             counts = st.touched.astype(jnp.float32)
             total = jax.lax.psum(counts, self.axis)
 
@@ -54,15 +51,30 @@ class FFMMixTrainer:
             # average, keeping the mixed linear term effective. w is mixed
             # too: it is read directly by predict for features not updated
             # again.
-            st = st.replace(
+            # pcast re-tags device-invariant pmean results as mesh-varying so
+            # the grouped-scan carry type stays consistent
+            revary = lambda x: jax.lax.pcast(x, self.axis, to="varying")
+            return st.replace(
                 w=touch_avg(st.w),
                 z=touch_avg(st.z),
                 n=touch_avg(st.n),
-                v=jax.lax.pmean(st.v, self.axis),
-                w0=jax.lax.pmean(st.w0, self.axis),
+                v=revary(jax.lax.pmean(st.v, self.axis)),
+                w0=revary(jax.lax.pmean(st.w0, self.axis)),
             )
+
+        def device_step(state: FFMState, indices, values, fields, labels):
+            st = jax.tree.map(lambda x: x[0], state)
+
+            def body(s, blk):
+                s, loss = local_step(s, *blk)
+                return s, loss
+
+            st, loss = grouped_mix_scan(
+                body, mix, st,
+                (indices[0], values[0], fields[0], labels[0]),
+                config.mix_every)
             return jax.tree.map(lambda x: x[None], st), jax.lax.psum(
-                jnp.sum(losses), self.axis)
+                loss, self.axis)
 
         spec_state = jax.tree.map(lambda _: P(self.axis),
                                   jax.eval_shape(lambda: init_ffm_state(hyper)))

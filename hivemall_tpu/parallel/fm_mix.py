@@ -11,6 +11,10 @@ shards and mix every k blocks —
 - AdaGrad-style slots are NOT mixed (device-local, like the reference where
   optimizer state never crossed the MIX wire — only weights did,
   ref: MixMessage carries weight/covar only, mix/MixMessage.java:26-95).
+
+Mix cadence is MixConfig.mix_every, uniform with MixTrainer: the default (1)
+mixes after every block; pass mix_every=k to train k blocks locally between
+collectives (the syncThreshold analog, MixServerHandler.java:142-148).
 """
 
 from __future__ import annotations
@@ -26,32 +30,25 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.fm import FMHyper, FMState, init_fm_state, make_fm_step
 from .mesh import WORKER_AXIS, make_mesh
+from .mix import MixConfig, grouped_mix_scan
 
 
 class FMMixTrainer:
     def __init__(self, hyper: FMHyper, dims: int, mesh: Optional[Mesh] = None,
-                 mode: str = "minibatch", axis_name: str = WORKER_AXIS):
+                 mode: str = "minibatch", config: MixConfig = MixConfig()):
         self.hyper = hyper
         self.dims = dims
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_dev = self.mesh.devices.size
-        self.axis = axis_name
+        self.config = config
+        self.axis = config.axis_name
 
         # raw (unjitted) local step: rebuild without jit wrapper
         local_step = make_fm_step(hyper, mode)
         # make_fm_step returns a jitted fn; jitted fns compose fine inside
         # shard_map (they inline at trace time)
 
-        def device_step(state: FMState, indices, values, labels, va):
-            st = jax.tree.map(lambda x: x[0], state)
-            blocks = (indices[0], values[0], labels[0], va[0])
-
-            def body(s, blk):
-                s, loss = local_step(s, *blk)
-                return s, loss
-
-            st, losses = jax.lax.scan(body, st, blocks)
-            # ---- mix ----
+        def mix(st: FMState) -> FMState:
             counts = st.touched.astype(jnp.float32)
             total = jax.lax.psum(counts, self.axis)
             w = jnp.where(total > 0,
@@ -60,10 +57,23 @@ class FMMixTrainer:
             v = jnp.where(total[:, None] > 0,
                           jax.lax.psum(st.v * counts[:, None], self.axis)
                           / jnp.maximum(total, 1.0)[:, None], st.v)
-            w0 = jax.lax.pmean(st.w0, self.axis)
-            st = st.replace(w=w, v=v, w0=w0)
+            # pcast re-tags the device-invariant pmean result as mesh-varying
+            # so the grouped-scan carry type stays consistent
+            w0 = jax.lax.pcast(jax.lax.pmean(st.w0, self.axis), self.axis, to="varying")
+            return st.replace(w=w, v=v, w0=w0)
+
+        def device_step(state: FMState, indices, values, labels, va):
+            st = jax.tree.map(lambda x: x[0], state)
+
+            def body(s, blk):
+                s, loss = local_step(s, *blk)
+                return s, loss
+
+            st, loss = grouped_mix_scan(
+                body, mix, st, (indices[0], values[0], labels[0], va[0]),
+                config.mix_every)
             return jax.tree.map(lambda x: x[None], st), jax.lax.psum(
-                jnp.sum(losses), self.axis)
+                loss, self.axis)
 
         spec_state = jax.tree.map(lambda _: P(self.axis),
                                   jax.eval_shape(lambda: init_fm_state(dims, hyper)))

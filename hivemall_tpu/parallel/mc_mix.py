@@ -21,33 +21,28 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.multiclass import (MCRule, MulticlassState, make_mc_train_step)
 from .mesh import WORKER_AXIS, make_mesh
+from .mix import MixConfig, grouped_mix_scan, merge_slot_arrays
 
 
 class MulticlassMixTrainer:
     def __init__(self, rule: MCRule, hyper: dict, num_labels: int, dims: int,
                  mesh: Optional[Mesh] = None, mode: str = "minibatch",
-                 reduction: str = "auto", axis_name: str = WORKER_AXIS):
+                 config: MixConfig = MixConfig()):
         self.rule = rule
         self.num_labels = num_labels
         self.dims = dims
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_dev = self.mesh.devices.size
-        self.axis = axis_name
+        self.config = config
+        self.axis = config.axis_name
+        reduction = config.reduction
         if reduction == "auto":
             reduction = "argmin_kld" if rule.use_covariance else "average"
         self.reduction = reduction
 
         local_step = make_mc_train_step(rule, hyper, mode)
 
-        def device_step(state: MulticlassState, indices, values, labels):
-            st = jax.tree.map(lambda x: x[0], state)
-            blocks = (indices[0], values[0], labels[0])
-
-            def body(s, blk):
-                s, loss = local_step(s, blk[0], blk[1], blk[2].astype(jnp.int32))
-                return s, loss
-
-            st, losses = jax.lax.scan(body, st, blocks)
+        def mix(st: MulticlassState) -> MulticlassState:
             counts = st.touched.astype(jnp.float32)  # [L, D]
             total = jax.lax.psum(counts, self.axis)
             if self.reduction == "argmin_kld":
@@ -57,14 +52,24 @@ class MulticlassMixTrainer:
                               jax.lax.psum(st.weights * inv, self.axis) / sum_inv,
                               st.weights)
                 cov = jnp.where(total > 0, 1.0 / sum_inv, st.covars)
-                st = st.replace(weights=w, covars=cov)
-            else:
-                w = jnp.where(total > 0,
-                              jax.lax.psum(st.weights * counts, self.axis)
-                              / jnp.maximum(total, 1.0), st.weights)
-                st = st.replace(weights=w)
+                return st.replace(weights=w, covars=cov)
+            w = jnp.where(total > 0,
+                          jax.lax.psum(st.weights * counts, self.axis)
+                          / jnp.maximum(total, 1.0), st.weights)
+            return st.replace(weights=w)
+
+        def device_step(state: MulticlassState, indices, values, labels):
+            st = jax.tree.map(lambda x: x[0], state)
+
+            def body(s, blk):
+                s, loss = local_step(s, blk[0], blk[1], blk[2].astype(jnp.int32))
+                return s, loss
+
+            st, loss = grouped_mix_scan(
+                body, mix, st, (indices[0], values[0], labels[0]),
+                config.mix_every)
             return jax.tree.map(lambda x: x[None], st), jax.lax.psum(
-                jnp.sum(losses), self.axis)
+                loss, self.axis)
 
         def init_one() -> MulticlassState:
             L = num_labels
@@ -99,10 +104,21 @@ class MulticlassMixTrainer:
         return self._step(state, indices, values, labels)
 
     def final_state(self, state) -> MulticlassState:
+        """Collapse the device axis: weights/covars are identical across
+        replicas after the trailing mix; touched unions; any populated
+        optimizer slots merge per MCRule.slot_merge through the same
+        machinery as linear/FFM (merge_slot_arrays) rather than silently
+        keeping replica 0's. (No current MC rule produces slots during
+        training — this guards the collapse itself.)"""
         host = jax.device_get(state)
         merged = jax.tree.map(lambda x: x[0], host)
+        touched_all = np.asarray(host.touched)  # [n_dev, L, D]
         step_all = np.asarray(host.step)
-        return merged.replace(
-            touched=np.max(np.asarray(host.touched), axis=0),
+        merged = merged.replace(
+            touched=np.max(touched_all, axis=0),
             step=step_all.sum().astype(step_all.dtype),
         )
+        if host.slots:
+            merged = merged.replace(slots=merge_slot_arrays(
+                host.slots, touched_all, dict(self.rule.slot_merge)))
+        return merged

@@ -66,6 +66,63 @@ def mix_argmin_kld(weights, covars, delta_upd, axis_name: str = WORKER_AXIS):
     return mixed_w, mixed_cov, total
 
 
+def grouped_mix_scan(local_body, mix, state, blocks, mix_every: int):
+    """Consume `blocks` (a tuple of arrays, each [k, ...]) in groups of
+    `mix_every`, training locally within a group and applying `mix` once per
+    group — the sync-threshold semantic shared by every mix trainer (the
+    server replies with the global average only when a feature's clock
+    advanced >= syncThreshold, ref: MixServerHandler.java:142-148).
+
+    local_body: (state, block_tuple) -> (state, loss)
+    mix:        state -> state
+    Returns (state, total_loss).
+    """
+    k = jax.tree.leaves(blocks)[0].shape[0]
+    if k % mix_every != 0:
+        raise ValueError(
+            f"{k} blocks per device not divisible by mix_every={mix_every}")
+    groups = jax.tree.map(
+        lambda a: a.reshape((k // mix_every, mix_every) + a.shape[1:]), blocks)
+
+    def group_body(s, grp):
+        s, losses = jax.lax.scan(local_body, s, grp)
+        return mix(s), jnp.sum(losses)
+
+    state, losses = jax.lax.scan(group_body, state, groups)
+    return state, jnp.sum(losses)
+
+
+def merge_slot_arrays(slots: dict, touched_all: np.ndarray, kinds: dict,
+                      drop: Tuple[str, ...] = ()) -> dict:
+    """Merge per-replica optimizer-slot arrays ([n_dev, ...]) into one model
+    per each slot's declared kind (Rule.slot_merge): "sum" for additive
+    statistics over the replicas' disjoint data shards, "mean" (default) for
+    decayed/averaged ones — weighted by which replicas actually touched each
+    entry. Slots named in `drop` reset to zero (pending-delta counters).
+    Shared by every trainer's final_state so no trainer silently keeps
+    replica 0's slots (the bug class fixed for linear/FFM in round 2)."""
+    tmask = touched_all.astype(np.float32)
+    n_touch = np.maximum(tmask.sum(axis=0), 1.0)
+    merged = {}
+    for name, arr in slots.items():
+        arr = np.asarray(arr)  # [n_dev, ...]
+        if name in drop:
+            merged[name] = np.zeros_like(arr[0])
+            continue
+        mask = tmask
+        denom = n_touch
+        # broadcast the touch mask over trailing axes (e.g. factor dims)
+        while mask.ndim < arr.ndim:
+            mask = mask[..., None]
+            denom = denom[..., None]
+        total = (arr * mask).sum(axis=0)
+        if kinds.get(name, "mean") == "sum":
+            merged[name] = total
+        else:
+            merged[name] = total / denom
+    return merged
+
+
 @dataclass(frozen=True)
 class MixConfig:
     # Mix after this many blocks — the sync-threshold analog: the reference's
@@ -117,26 +174,14 @@ class MixTrainer:
         def device_step(state: LinearState, indices, values, labels):
             # state leaves carry a leading [1] device axis inside shard_map
             st = jax.tree.map(lambda x: x[0], state)
-            k = indices.shape[1]
-            if k % mix_every != 0:
-                raise ValueError(
-                    f"{k} blocks per device not divisible by mix_every={mix_every}")
-            # [k, B, ...] -> [k/mix_every, mix_every, B, ...]: train a group
-            # locally, then one collective mix per group
-            groups = jax.tree.map(
-                lambda a: a.reshape((k // mix_every, mix_every) + a.shape[1:]),
-                (indices[0], values[0], labels[0]))
 
-            def group_body(s, grp):
-                def body(s, blk):
-                    s, loss = local_fn(s, *blk)
-                    return s, loss
+            def body(s, blk):
+                s, loss = local_fn(s, *blk)
+                return s, loss
 
-                s, losses = jax.lax.scan(body, s, grp)
-                return mix(s), jnp.sum(losses)
-
-            st, losses = jax.lax.scan(group_body, st, groups)
-            loss_sum = jax.lax.psum(jnp.sum(losses), axis)
+            st, loss = grouped_mix_scan(
+                body, mix, st, (indices[0], values[0], labels[0]), mix_every)
+            loss_sum = jax.lax.psum(loss, axis)
             return jax.tree.map(lambda x: x[None], st), loss_sum
 
         spec_state = jax.tree.map(lambda _: P(self.config.axis_name),
@@ -211,21 +256,9 @@ class MixTrainer:
         merged = merged.replace(touched=np.max(touched_all, axis=0))
 
         if host.slots:
-            kinds = dict(self.rule.slot_merge)
-            tmask = touched_all.astype(np.float32)
-            n_touch = np.maximum(tmask.sum(axis=0), 1.0)
-            new_slots = {}
-            for name, arr in host.slots.items():
-                arr = np.asarray(arr)  # [n_dev, D]
-                if name == DELTA_SLOT:
-                    new_slots[name] = np.zeros_like(arr[0])
-                    continue
-                total = (arr * tmask).sum(axis=0)
-                if kinds.get(name, "mean") == "sum":
-                    new_slots[name] = total
-                else:
-                    new_slots[name] = total / n_touch
-            merged = merged.replace(slots=new_slots)
+            merged = merged.replace(slots=merge_slot_arrays(
+                host.slots, touched_all, dict(self.rule.slot_merge),
+                drop=(DELTA_SLOT,)))
 
         gl = {k: np.asarray(v) for k, v in host.globals.items()}  # [n_dev] each
         if {"n", "mean", "m2"} <= set(gl):

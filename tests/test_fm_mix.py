@@ -26,8 +26,9 @@ def _gen(n=2048, d=24, seed=3):
 def test_fm_mix_trains_across_replicas():
     dims, n_dev, B, width = 64, 8, 32, 4
     idx, val, y = _gen()
+    # eta scaled for the averaged (sum/count) minibatch application
     hyper = FMHyper(factors=4, classification=True, lambda0=0.0,
-                    eta=fixed(0.05), seed=0)
+                    eta=fixed(0.2), seed=0)
     trainer = FMMixTrainer(hyper, dims, make_mesh(n_dev))
     n_blocks = len(idx) // B  # 64 blocks -> [8, 8, B]
     k = n_blocks // n_dev

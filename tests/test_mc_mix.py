@@ -6,6 +6,7 @@ import numpy as np
 from hivemall_tpu.models.multiclass import MC_AROW, MC_PERCEPTRON
 from hivemall_tpu.parallel import make_mesh
 from hivemall_tpu.parallel.mc_mix import MulticlassMixTrainer
+from hivemall_tpu.parallel.mix import MixConfig
 
 
 def _gen(n=1024, d=12, k=3, seed=4):
@@ -42,7 +43,8 @@ def test_mc_mix_average():
     n_dev, B, d, k = 4, 32, 12, 3
     x, y = _gen(seed=9)
     trainer = MulticlassMixTrainer(MC_PERCEPTRON, {}, num_labels=k, dims=d,
-                                   mesh=make_mesh(n_dev), reduction="average")
+                                   mesh=make_mesh(n_dev),
+                                   config=MixConfig(reduction="average"))
     n_blocks = len(y) // B
     kk = n_blocks // n_dev
     I = np.tile(np.arange(d, dtype=np.int32), (n_blocks, B, 1))
