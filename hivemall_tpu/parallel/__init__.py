@@ -1,2 +1,3 @@
-from .mesh import make_mesh  # noqa: F401
+from .mesh import make_mesh, make_mesh_2d  # noqa: F401
 from .mix import MixConfig, MixTrainer, mix_average, mix_argmin_kld  # noqa: F401
+from .sharded_train import Sharded2DTrainer, ShardedTrainer  # noqa: F401

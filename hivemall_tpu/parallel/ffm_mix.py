@@ -23,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.ffm import FFMHyper, FFMState, init_ffm_state, make_ffm_step
 from .mesh import WORKER_AXIS, make_mesh
-from .mix import MixConfig, grouped_mix_scan
+from .mix import MixConfig, grouped_mix_scan, replicate_state
 
 
 class FFMMixTrainer:
@@ -89,12 +89,8 @@ class FFMMixTrainer:
         )
 
     def init(self) -> FFMState:
-        one = init_ffm_state(self.hyper)
-        stacked = jax.tree.map(
-            lambda x: jnp.broadcast_to(x[None], (self.n_dev,) + x.shape), one)
-        return jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(
-                self.mesh, P(*((self.axis,) + (None,) * (x.ndim - 1))))), stacked)
+        return replicate_state(init_ffm_state(self.hyper), self.n_dev,
+                               self.mesh, axis=self.axis)
 
     def step(self, state, indices, values, fields, labels):
         return self._step(state, indices, values, fields, labels)

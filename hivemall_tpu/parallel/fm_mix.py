@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.fm import FMHyper, FMState, init_fm_state, make_fm_step
 from .mesh import WORKER_AXIS, make_mesh
-from .mix import MixConfig, grouped_mix_scan
+from .mix import MixConfig, grouped_mix_scan, replicate_state
 
 
 class FMMixTrainer:
@@ -89,12 +89,8 @@ class FMMixTrainer:
         )
 
     def init(self) -> FMState:
-        one = init_fm_state(self.dims, self.hyper)
-        stacked = jax.tree.map(
-            lambda x: jnp.broadcast_to(x[None], (self.n_dev,) + x.shape), one)
-        return jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(
-                self.mesh, P(*((self.axis,) + (None,) * (x.ndim - 1))))), stacked)
+        return replicate_state(init_fm_state(self.dims, self.hyper),
+                               self.n_dev, self.mesh, axis=self.axis)
 
     def step(self, state: FMState, indices, values, labels, va=None):
         """indices/values/labels: [n_dev, k, B, ...]."""

@@ -21,7 +21,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..models.multiclass import (MCRule, MulticlassState, make_mc_train_step)
 from .mesh import WORKER_AXIS, make_mesh
-from .mix import MixConfig, grouped_mix_scan, merge_slot_arrays
+from .mix import (MixConfig, grouped_mix_scan, merge_slot_arrays,
+                  replicate_state)
 
 
 class MulticlassMixTrainer:
@@ -93,12 +94,8 @@ class MulticlassMixTrainer:
         )
 
     def init(self) -> MulticlassState:
-        one = self._init_one()
-        stacked = jax.tree.map(
-            lambda x: jnp.broadcast_to(x[None], (self.n_dev,) + x.shape), one)
-        return jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(
-                self.mesh, P(*((self.axis,) + (None,) * (x.ndim - 1))))), stacked)
+        return replicate_state(self._init_one(), self.n_dev, self.mesh,
+                               axis=self.axis)
 
     def step(self, state, indices, values, labels):
         return self._step(state, indices, values, labels)

@@ -123,6 +123,93 @@ def merge_slot_arrays(slots: dict, touched_all: np.ndarray, kinds: dict,
     return merged
 
 
+def replicate_state(one, n_replicas: int, mesh: Mesh, specs=None,
+                    axis: str = WORKER_AXIS):
+    """Broadcast a single-model pytree to a leading [n_replicas] axis and
+    place it on the mesh. Default placement: replica axis sharded over
+    `axis`, everything else replicated; pass `specs` (a pytree of
+    PartitionSpec with the leading replica dim included) to additionally
+    stripe trailing dims. One copy of the broadcast-then-place init shared by
+    every replicated trainer."""
+    stacked = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n_replicas,) + x.shape), one)
+    if specs is None:
+        specs = jax.tree.map(
+            lambda x: P(*((axis,) + (None,) * (x.ndim - 1))), stacked)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), stacked, specs)
+
+
+def split_replica_blocks(n_replicas: int, *arrays):
+    """Host helper shared by the replicated trainers: split [R * k, B, ...]
+    blocks into the [R, k, B, ...] layout."""
+    nk = arrays[0].shape[0]
+    k = nk // n_replicas
+    if k * n_replicas != nk:
+        raise ValueError(f"{nk} blocks not divisible by {n_replicas} replicas")
+    return tuple(a.reshape((n_replicas, k) + a.shape[1:]) for a in arrays)
+
+
+def make_linear_mix(reduction: str, axis: str):
+    """The collective mix applied to a LinearState replica: delta-weighted
+    average or argminKLD over `axis`, then reset the pending-delta counter.
+    Shared by the data-parallel MixTrainer and the replica axis of the 2-D
+    (replicas x feature stripes) trainer."""
+
+    def mix(st: LinearState) -> LinearState:
+        delta = st.slots[DELTA_SLOT]
+        if reduction == "argmin_kld":
+            w, cov, _ = mix_argmin_kld(st.weights, st.covars, delta, axis)
+            st = st.replace(weights=w, covars=cov)
+        else:
+            w, _ = mix_average(st.weights, delta, axis)
+            st = st.replace(weights=w)
+        return st.replace(slots={**st.slots, DELTA_SLOT: jnp.zeros_like(delta)})
+
+    return mix
+
+
+def collapse_linear_replicas(host: LinearState, slot_kinds: dict) -> LinearState:
+    """Collapse a host-side LinearState whose leaves carry a leading replica
+    axis into one model a warm restart can resume from (the mixed analog of
+    -loadmodel, ref: LearnerBaseUDTF.java:215-333).
+
+    - weights/covars: identical across replicas after the trailing mix —
+      replica 0's copy IS the mixed model;
+    - touched: max (union of features any replica updated);
+    - optimizer slots: merged per the rule's declared kind over the replicas
+      that touched each feature (merge_slot_arrays); the delta counter resets;
+    - Welford globals (n, mean, m2): exact Chan parallel merge across the
+      replicas' disjoint shards (ref: common/OnlineVariance.java); other
+      globals keep replica 0's value.
+    """
+    merged = jax.tree.map(lambda x: x[0], host)
+    touched_all = np.asarray(host.touched)
+    merged = merged.replace(touched=np.max(touched_all, axis=0))
+
+    if host.slots:
+        merged = merged.replace(slots=merge_slot_arrays(
+            host.slots, touched_all, slot_kinds, drop=(DELTA_SLOT,)))
+
+    gl = {k: np.asarray(v) for k, v in host.globals.items()}  # [n_dev] each
+    if {"n", "mean", "m2"} <= set(gl):
+        n = gl["n"].astype(np.float64)
+        tot = n.sum()
+        if tot > 0:
+            mean = float((gl["mean"] * n).sum() / tot)
+            m2 = float(gl["m2"].sum()
+                       + (n * (gl["mean"] - mean) ** 2).sum())
+            merged = merged.replace(globals={
+                **merged.globals,
+                "n": np.float32(tot),
+                "mean": np.float32(mean),
+                "m2": np.float32(m2),
+            })
+    step_all = np.asarray(host.step)
+    merged = merged.replace(step=step_all.sum().astype(step_all.dtype))
+    return merged
+
+
 @dataclass(frozen=True)
 class MixConfig:
     # Mix after this many blocks — the sync-threshold analog: the reference's
@@ -160,16 +247,7 @@ class MixTrainer:
         local_fn = make_train_fn(rule, hyper, mode=mode, track_deltas=True)
 
         mix_every = config.mix_every
-
-        def mix(st: LinearState) -> LinearState:
-            delta = st.slots[DELTA_SLOT]
-            if self.reduction == "argmin_kld":
-                w, cov, _ = mix_argmin_kld(st.weights, st.covars, delta, axis)
-                st = st.replace(weights=w, covars=cov)
-            else:
-                w, _ = mix_average(st.weights, delta, axis)
-                st = st.replace(weights=w)
-            return st.replace(slots={**st.slots, DELTA_SLOT: jnp.zeros_like(delta)})
+        mix = make_linear_mix(self.reduction, axis)
 
         def device_step(state: LinearState, indices, values, labels):
             # state leaves carry a leading [1] device axis inside shard_map
@@ -210,14 +288,8 @@ class MixTrainer:
     def init(self) -> LinearState:
         """Replicated initial state with a leading device axis, sharded over
         the mesh."""
-        one = self._init_one()
-        stacked = jax.tree.map(
-            lambda x: jnp.broadcast_to(x[None], (self.n_dev,) + x.shape), one)
-        sharding = NamedSharding(self.mesh, P(self.config.axis_name))
-        return jax.tree.map(
-            lambda x: jax.device_put(x, NamedSharding(
-                self.mesh, P(*( (self.config.axis_name,) + (None,) * (x.ndim - 1) )))),
-            stacked)
+        return replicate_state(self._init_one(), self.n_dev, self.mesh,
+                               axis=self.config.axis_name)
 
     def step(self, state: LinearState, indices, values, labels):
         """One mixed step. indices/values/labels: [n_dev, k, B, ...] — each
@@ -227,53 +299,10 @@ class MixTrainer:
     def shard_blocks(self, indices, values, labels):
         """Host helper: split [n_dev * k, B, ...] host blocks into the
         [n_dev, k, B, ...] layout."""
-        nk = indices.shape[0]
-        k = nk // self.n_dev
-        if k * self.n_dev != nk:
-            raise ValueError(f"{nk} blocks not divisible by {self.n_dev} devices")
-        reshape = lambda a: a.reshape((self.n_dev, k) + a.shape[1:])
-        return reshape(indices), reshape(values), reshape(labels)
+        return split_replica_blocks(self.n_dev, indices, values, labels)
 
     def final_state(self, state: LinearState) -> LinearState:
         """Collapse the device axis after the trailing mix into one model a
-        warm restart can resume from (the mixed analog of -loadmodel,
-        ref: LearnerBaseUDTF.java:215-333).
-
-        - weights/covars: identical across replicas after the trailing mix —
-          replica 0's copy IS the mixed model;
-        - touched: max (union of features any replica updated);
-        - optimizer slots: merged per the rule's declared kind over the
-          replicas that touched each feature — "sum" for additive statistics,
-          "mean" (the default) for decayed ones (Rule.slot_merge); the delta
-          counter resets (nothing is pending after the trailing mix);
-        - Welford globals (n, mean, m2): exact Chan parallel merge across the
-          replicas' disjoint shards (ref: common/OnlineVariance.java); other
-          globals keep replica 0's value.
-        """
-        host = jax.device_get(state)
-        merged = jax.tree.map(lambda x: x[0], host)
-        touched_all = np.asarray(host.touched)  # [n_dev, D] int8
-        merged = merged.replace(touched=np.max(touched_all, axis=0))
-
-        if host.slots:
-            merged = merged.replace(slots=merge_slot_arrays(
-                host.slots, touched_all, dict(self.rule.slot_merge),
-                drop=(DELTA_SLOT,)))
-
-        gl = {k: np.asarray(v) for k, v in host.globals.items()}  # [n_dev] each
-        if {"n", "mean", "m2"} <= set(gl):
-            n = gl["n"].astype(np.float64)
-            tot = n.sum()
-            if tot > 0:
-                mean = float((gl["mean"] * n).sum() / tot)
-                m2 = float(gl["m2"].sum()
-                           + (n * (gl["mean"] - mean) ** 2).sum())
-                merged = merged.replace(globals={
-                    **merged.globals,
-                    "n": np.float32(tot),
-                    "mean": np.float32(mean),
-                    "m2": np.float32(m2),
-                })
-        step_all = np.asarray(host.step)
-        merged = merged.replace(step=step_all.sum().astype(step_all.dtype))
-        return merged
+        warm restart can resume from — see collapse_linear_replicas."""
+        return collapse_linear_replicas(jax.device_get(state),
+                                        dict(self.rule.slot_merge))

@@ -26,6 +26,26 @@ def shard_weights(weights, mesh: Mesh, axis_name: str = WORKER_AXIS):
     return jax.device_put(weights, NamedSharding(mesh, P(axis_name)))
 
 
+def stripe_score(axis_name: str, stripe: int):
+    """The per-device scoring body shared by sharded predict AND sharded
+    training's serving path (ShardedTrainer.make_predict): translate global
+    feature ids into the local [stripe] table, gather (foreign/OOB lanes
+    contribute 0), psum the partial dot products over the stripe axis. One
+    copy of the stripe-placement math so trained-sharded and served-sharded
+    states cannot drift."""
+
+    def local_score(w_local, indices, values):
+        dev = jax.lax.axis_index(axis_name)
+        local_idx = indices - dev * stripe
+        in_range = (local_idx >= 0) & (local_idx < stripe)
+        local_idx = jnp.where(in_range, local_idx, stripe)  # OOB -> dropped by fill
+        w = w_local.at[local_idx].get(mode="fill", fill_value=0.0)
+        partial_scores = jnp.sum(w * values * in_range.astype(values.dtype), axis=-1)
+        return jax.lax.psum(partial_scores, axis_name)
+
+    return local_score
+
+
 def make_sharded_predict(mesh: Mesh, dims: int, axis_name: str = WORKER_AXIS):
     """Jitted scoring with the weight table feature-sharded: each device
     gathers its stripe's hits (OOB hits drop to 0) and partial scores psum
@@ -35,18 +55,8 @@ def make_sharded_predict(mesh: Mesh, dims: int, axis_name: str = WORKER_AXIS):
     if shard * n != dims:
         raise ValueError(f"dims {dims} not divisible by {n} devices")
 
-    def local_score(w_local, indices, values):
-        # w_local: [D/n]; translate global ids into the local stripe
-        dev = jax.lax.axis_index(axis_name)
-        local_idx = indices - dev * shard
-        in_range = (local_idx >= 0) & (local_idx < shard)
-        local_idx = jnp.where(in_range, local_idx, shard)  # OOB -> dropped by fill
-        w = w_local.at[local_idx].get(mode="fill", fill_value=0.0)
-        partial_scores = jnp.sum(w * values * in_range.astype(values.dtype), axis=-1)
-        return jax.lax.psum(partial_scores, axis_name)
-
     fn = jax.shard_map(
-        local_score,
+        stripe_score(axis_name, shard),
         mesh=mesh,
         in_specs=(P(axis_name), P(), P()),
         out_specs=P(),

@@ -20,10 +20,24 @@ copy of the update-application logic, sharded or not. Parity vs the
 single-device engine is exact up to psum summation order
 (tests/test_sharded_train.py).
 
-Unlike the data-parallel MixTrainer (full replica per device, periodic
-averaging), this path trains ONE model too big for one chip's HBM — e.g.
-covariance + optimizer slots at 2^24+ dims — the TP analog this workload
-admits (SURVEY.md §2.18 "feature-sharded servers → model-dim sharding").
+Arbitrary dims: when dims is not divisible by the stripe count the tables
+pad up to `stripe * n_shards`. The padding slots are safe by the engine's
+own protocol: data pad lanes carry value 0, every linear rule's lane deltas
+are proportional to the lane value (so they vanish), and the only writes that
+can land in a padding slot are the touched/delta-count marks — slots past
+`dims` that no predict or export ever reads (final states slice back to
+[:dims]).
+
+Two trainers:
+- `ShardedTrainer` — 1-D mesh, ONE model too big for one chip's HBM (e.g.
+  covariance + optimizer slots at 2^24+ dims); blocks replicated.
+- `Sharded2DTrainer` — 2-D (replicas x stripes) mesh: each replica holds a
+  feature-sharded model and trains its own data shard; every `mix_every`
+  blocks the replicas delta-weighted-average along the replica axis. This is
+  the reference's actual production topology: N mapper clients training
+  concurrently against M feature-sharded MIX servers
+  (ref: MixRequestRouter.java:56-60 + MixServerHandler.java:118-158,
+  MixServerTest.java:122-151 five concurrent clients).
 """
 
 from __future__ import annotations
@@ -31,22 +45,39 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..core.engine import Rule, make_train_fn
+from ..core.engine import DELTA_SLOT, Rule, make_train_fn
 from ..core.state import LinearState, init_linear_state
-from .mesh import make_mesh
+from .mesh import SHARD_AXIS, WORKER_AXIS, make_mesh, make_mesh_2d
+from .mix import (MixConfig, collapse_linear_replicas, grouped_mix_scan,
+                  make_linear_mix, replicate_state, split_replica_blocks)
+from .sharded import stripe_score
+
+
+def _pad_initial(arr, dims_padded, fill=0.0):
+    """Pad a user-provided [dims] warm-start array up to the sharded table
+    size. Weights pad with 0; covariances pad with 1.0 (their init value) —
+    the argminKLD mix reads 1/cov on every slot, so a zero-padded covariance
+    would put inf/NaN in the padding lanes."""
+    arr = np.asarray(arr)
+    if arr.shape[0] == dims_padded:
+        return arr
+    return np.pad(arr, (0, dims_padded - arr.shape[0]),
+                  constant_values=fill)
 
 
 class ShardedTrainer:
     """Train a single feature-sharded model across the mesh.
 
-    The state returned by `init()` / threaded through `step()` is a full-dims
-    LinearState whose [D] leaves carry a NamedSharding along the feature dim —
-    each device materializes only its [D/n] stripe in HBM. Blocks are
-    replicated (every device sees every row; the model, not the data, is what
-    doesn't fit).
+    The state returned by `init()` / threaded through `step()` is a
+    padded-dims LinearState whose [D] leaves carry a NamedSharding along the
+    feature dim — each device materializes only its [D/n] stripe in HBM.
+    Blocks are replicated (every device sees every row; the model, not the
+    data, is what doesn't fit).
     """
 
     def __init__(self, rule: Rule, hyper: dict, dims: int,
@@ -61,9 +92,8 @@ class ShardedTrainer:
                 f"ShardedTrainer needs a 1-D mesh, got axes {self.mesh.axis_names}")
         self.axis = self.mesh.axis_names[0]
         n = self.mesh.devices.size
-        if dims % n != 0:
-            raise ValueError(f"dims {dims} not divisible by {n} devices")
-        self.stripe = dims // n
+        self.stripe = -(-dims // n)  # ceil: arbitrary dims pad up
+        self.dims_padded = self.stripe * n
 
         body_fn = make_train_fn(rule, hyper, mode=mode,
                                 mini_batch_average=mini_batch_average,
@@ -86,7 +116,7 @@ class ShardedTrainer:
 
     def _init_one(self, **kwargs) -> LinearState:
         return init_linear_state(
-            self.dims,
+            self.dims_padded,
             use_covariance=self.rule.use_covariance,
             slot_names=tuple(self.rule.slot_names),
             global_names=self.rule.global_names,
@@ -97,7 +127,11 @@ class ShardedTrainer:
         """Initial state with [D] leaves placed feature-sharded on the mesh —
         each device allocates only its stripe. kwargs pass through to
         init_linear_state (initial_weights/initial_covars = -loadmodel warm
-        start, ref: LearnerBaseUDTF.java:215-333)."""
+        start, ref: LearnerBaseUDTF.java:215-333); [dims] arrays pad up to
+        the sharded table size."""
+        for key, fill in (("initial_weights", 0.0), ("initial_covars", 1.0)):
+            if kwargs.get(key) is not None:
+                kwargs[key] = _pad_initial(kwargs[key], self.dims_padded, fill)
         state = self._init_one(**kwargs)
         return jax.tree.map(
             lambda leaf, spec: jax.device_put(
@@ -108,3 +142,180 @@ class ShardedTrainer:
         """One sharded train step. indices/values: [B, K]; labels: [B]
         (replicated to every device — the model is what's sharded)."""
         return self._step(state, indices, values, labels)
+
+    def final_state(self, state: LinearState) -> LinearState:
+        """Host-side copy with the padding sliced back off — a plain [dims]
+        model for export / warm start / init_linear_state round trips."""
+        host = jax.device_get(state)
+        unpad = lambda x: x[: self.dims] if (
+            getattr(x, "ndim", 0) == 1 and x.shape[0] == self.dims_padded) else x
+        return jax.tree.map(unpad, host)
+
+    def make_predict(self):
+        """Jitted scoring that consumes the TRAINED sharded state directly —
+        same mesh, same stripe placement, same stripe_score body as
+        parallel/sharded.make_sharded_predict, so a model trained sharded
+        serves sharded with no re-placement step."""
+        fn = jax.shard_map(
+            stripe_score(self.axis, self.stripe),
+            mesh=self.mesh,
+            in_specs=(P(self.axis), P(), P()),
+            out_specs=P(),
+        )
+        jfn = jax.jit(fn)
+
+        def predict(state: LinearState, indices, values):
+            return jfn(state.weights, indices, values)
+
+        return predict
+
+
+class Sharded2DTrainer:
+    """Replicas x feature stripes: R data-parallel model replicas, each
+    feature-sharded over S devices. Per-row score/norm/variance partials
+    psum along the stripe axis (every device of a replica sees the global
+    row scalars); every `config.mix_every` blocks the replicas mix along the
+    replica axis with the delta-weighted average / argminKLD reduction —
+    stripe-local, no cross-stripe traffic.
+
+    Blocks: [R, k, B, K] — replica r trains its own k blocks (data
+    parallelism), every stripe of a replica sees all of that replica's rows.
+
+    Cadence note: for covariance learners the argminKLD mix SHRINKS the
+    mixed covariance (1/sum(1/cov)) every time it fires — mixing after every
+    block freezes the learner early. The reference gates server replies at
+    syncThreshold=30 clock ticks (MixServerHandler.java:142-148); pick
+    mix_every accordingly (tens of blocks), not 1.
+    """
+
+    def __init__(self, rule: Rule, hyper: dict, dims: int,
+                 mesh: Optional[Mesh] = None,
+                 n_replicas: Optional[int] = None,
+                 n_shards: Optional[int] = None,
+                 config: MixConfig = MixConfig(), mode: str = "minibatch",
+                 mini_batch_average: bool = True):
+        self.rule = rule
+        self.hyper = hyper
+        self.dims = dims
+        if mesh is None:
+            if n_replicas is None or n_shards is None:
+                raise ValueError(
+                    "pass either a 2-D mesh or both n_replicas and n_shards")
+            mesh = make_mesh_2d(n_replicas, n_shards)
+        if len(mesh.axis_names) != 2:
+            raise ValueError(
+                f"Sharded2DTrainer needs a 2-D mesh, got axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.replica_axis, self.shard_axis = mesh.axis_names
+        self.n_replicas = mesh.shape[self.replica_axis]
+        self.n_shards = mesh.shape[self.shard_axis]
+        self.config = config
+        self.stripe = -(-dims // self.n_shards)
+        self.dims_padded = self.stripe * self.n_shards
+        reduction = config.reduction
+        if reduction == "auto":
+            reduction = "argmin_kld" if rule.use_covariance else "average"
+        self.reduction = reduction
+
+        local_fn = make_train_fn(rule, hyper, mode=mode,
+                                 mini_batch_average=mini_batch_average,
+                                 track_deltas=True,
+                                 feature_shard=(self.shard_axis, self.stripe))
+        mix = make_linear_mix(self.reduction, self.replica_axis)
+        mix_every = config.mix_every
+
+        def device_step(state: LinearState, indices, values, labels):
+            # leaves carry a leading [1] replica axis inside shard_map
+            st = jax.tree.map(lambda x: x[0], state)
+
+            def body(s, blk):
+                s, loss = local_fn(s, *blk)
+                return s, loss
+
+            st, loss = grouped_mix_scan(
+                body, mix, st, (indices[0], values[0], labels[0]), mix_every)
+            # loss is identical on every stripe (computed from psummed row
+            # scalars); sum it over the replicas
+            loss_sum = jax.lax.psum(loss, self.replica_axis)
+            return jax.tree.map(lambda x: x[None], st), loss_sum
+
+        state_shape = jax.eval_shape(self._init_one)
+        # replica axis leads every leaf; [D] leaves additionally stripe
+        specs = jax.tree.map(
+            lambda leaf: P(self.replica_axis, self.shard_axis)
+            if leaf.ndim == 1 else P(self.replica_axis), state_shape)
+        self._specs = specs
+        blk = P(self.replica_axis)
+        self._step = jax.jit(
+            jax.shard_map(
+                device_step,
+                mesh=self.mesh,
+                in_specs=(specs, blk, blk, blk),
+                out_specs=(specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def _init_one(self, **kwargs) -> LinearState:
+        return init_linear_state(
+            self.dims_padded,
+            use_covariance=self.rule.use_covariance,
+            slot_names=tuple(self.rule.slot_names) + (DELTA_SLOT,),
+            global_names=self.rule.global_names,
+            **kwargs,
+        )
+
+    def init(self, **kwargs) -> LinearState:
+        """Replicated-then-striped initial state: every leaf gains a leading
+        [R] replica axis; [D] leaves additionally shard into [D/S] stripes —
+        each device allocates [1, stripe]."""
+        for key, fill in (("initial_weights", 0.0), ("initial_covars", 1.0)):
+            if kwargs.get(key) is not None:
+                kwargs[key] = _pad_initial(kwargs[key], self.dims_padded, fill)
+        return replicate_state(self._init_one(**kwargs), self.n_replicas,
+                               self.mesh, specs=self._specs,
+                               axis=self.replica_axis)
+
+    def step(self, state: LinearState, indices, values, labels):
+        """indices/values: [R, k, B, K]; labels: [R, k, B] — replica r's k
+        blocks. Each group of mix_every blocks trains locally, then the
+        replicas mix."""
+        return self._step(state, indices, values, labels)
+
+    def shard_blocks(self, indices, values, labels):
+        """Host helper: split [R * k, B, ...] blocks into [R, k, B, ...]."""
+        return split_replica_blocks(self.n_replicas, indices, values, labels)
+
+    def final_state(self, state: LinearState) -> LinearState:
+        """Collapse the replica axis (collapse_linear_replicas: trailing-mix
+        weights, touched union, slot merge, Welford merge) and slice the
+        padding back off, returning a plain [dims] model."""
+        merged = collapse_linear_replicas(jax.device_get(state),
+                                          dict(self.rule.slot_merge))
+        unpad = lambda x: x[: self.dims] if (
+            getattr(x, "ndim", 0) == 1 and x.shape[0] == self.dims_padded) else x
+        return jax.tree.map(unpad, merged)
+
+    def make_predict(self):
+        """Serve the trained 2-D state without re-placement: replica 0's
+        stripes already lay [D/S] per device; score with the shared
+        stripe_score body, psum over the stripe axis."""
+        def local_score(w_local, indices, values):
+            # w_local: [1, stripe] (replica-axis leading)
+            return stripe_score(self.shard_axis, self.stripe)(
+                w_local[0], indices, values)
+
+        fn = jax.shard_map(
+            local_score,
+            mesh=self.mesh,
+            in_specs=(P(self.replica_axis, self.shard_axis), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        jfn = jax.jit(fn)
+
+        def predict(state: LinearState, indices, values):
+            return jfn(state.weights, indices, values)
+
+        return predict

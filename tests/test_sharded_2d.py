@@ -1,0 +1,140 @@
+"""DP x feature-sharding composition on the simulated 8-device CPU mesh.
+
+The topology under test is the reference's production shape: N mapper
+clients training concurrently against M feature-sharded MIX servers
+(ref: mix/client/MixRequestRouter.java:56-60 routing,
+mixserv/.../MixServerHandler.java:118-158 clock-gated averaging,
+MixServerTest.java:122-151 five concurrent clients). Sharded2DTrainer maps
+clients -> replica axis, servers -> stripe axis; correctness bar: a 2x4
+(replicas x stripes) run is numerically the replicas-only MixTrainer run —
+the stripe axis must not change the math — including on dims that do NOT
+divide the stripe count (padding path).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from hivemall_tpu.models.classifier import AROW, PERCEPTRON
+from hivemall_tpu.parallel import (MixConfig, MixTrainer, make_mesh,
+                                   make_mesh_2d)
+from hivemall_tpu.parallel.sharded_train import Sharded2DTrainer, ShardedTrainer
+
+R, S = 2, 4
+DIMS = 1003  # deliberately not divisible by S (stripe 251, padded 1004)
+
+
+def _gen_blocks(n_blocks, batch=16, width=8, seed=0, dims=DIMS):
+    rng = np.random.RandomState(seed)
+    idx = rng.randint(0, dims, size=(R, n_blocks, batch, width)).astype(np.int32)
+    val = rng.rand(R, n_blocks, batch, width).astype(np.float32)
+    lab = np.sign(rng.randn(R, n_blocks, batch)).astype(np.float32)
+    return idx, val, lab
+
+
+@pytest.mark.parametrize("rule,hyper", [(PERCEPTRON, {}), (AROW, {"r": 0.1})],
+                         ids=["average", "argmin_kld"])
+def test_2d_parity_vs_replicas_only(rule, hyper):
+    """2x4 (replicas x stripes) == 2-replica MixTrainer on the same blocks:
+    weights, covars, touched, and loss all match on the unpadded prefix."""
+    k = 4
+    idx, val, lab = _gen_blocks(k)
+
+    t2d = Sharded2DTrainer(rule, hyper, DIMS, make_mesh_2d(R, S),
+                           config=MixConfig(mix_every=2))
+    s2 = t2d.init()
+    s2, loss2 = t2d.step(s2, idx, val, lab)
+
+    tmix = MixTrainer(rule, hyper, DIMS, make_mesh(R),
+                      config=MixConfig(mix_every=2))
+    s1 = tmix.init()
+    s1, loss1 = tmix.step(s1, idx, val, lab)
+
+    h2, h1 = jax.device_get(s2), jax.device_get(s1)
+    np.testing.assert_allclose(np.asarray(h2.weights)[:, :DIMS],
+                               np.asarray(h1.weights), rtol=2e-5, atol=1e-6)
+    if rule.use_covariance:
+        np.testing.assert_allclose(np.asarray(h2.covars)[:, :DIMS],
+                                   np.asarray(h1.covars), rtol=2e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(h2.touched)[:, :DIMS],
+                                  np.asarray(h1.touched))
+    assert float(loss2) == pytest.approx(float(loss1), rel=1e-4)
+
+
+def test_2d_final_state_unpads_and_serves():
+    """final_state collapses the replica axis AND slices the padding off;
+    make_predict serves the trained sharded state directly with scores equal
+    to the host dot product."""
+    k = 2
+    idx, val, lab = _gen_blocks(k, seed=3)
+    trainer = Sharded2DTrainer(AROW, {"r": 0.1}, DIMS, make_mesh_2d(R, S))
+    state = trainer.init()
+    state, _ = trainer.step(state, idx, val, lab)
+
+    final = trainer.final_state(state)
+    assert final.weights.shape == (DIMS,)
+    assert final.covars.shape == (DIMS,)
+    assert int(final.step) == 2 * k * 16  # scan-mode? minibatch: B rows/block
+    w = np.asarray(final.weights)
+
+    predict = trainer.make_predict()
+    q_idx = idx[0, 0][:4]
+    q_val = val[0, 0][:4]
+    got = np.asarray(predict(state, q_idx, q_val))
+    want = (w[q_idx] * q_val).sum(axis=-1)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+
+def test_2d_mix_every_gates_replica_collective():
+    """mix_every must gate the replica-axis collective in the 2-D composition
+    exactly as in the 1-D MixTrainer: k=4 with one trailing mix differs from
+    mixing after every block."""
+    idx, val, lab = _gen_blocks(4, seed=5)
+    once = Sharded2DTrainer(AROW, {"r": 0.1}, DIMS, make_mesh_2d(R, S),
+                            config=MixConfig(mix_every=4))
+    s_once = once.init()
+    s_once, _ = once.step(s_once, idx, val, lab)
+    every = Sharded2DTrainer(AROW, {"r": 0.1}, DIMS, make_mesh_2d(R, S),
+                             config=MixConfig(mix_every=1))
+    s_every = every.init()
+    s_every, _ = every.step(s_every, idx, val, lab)
+    dw = np.abs(np.asarray(jax.device_get(s_once.weights))
+                - np.asarray(jax.device_get(s_every.weights))).max()
+    assert dw > 1e-6
+
+
+def test_1d_sharded_padding_parity():
+    """ShardedTrainer on non-divisible dims pads internally and still matches
+    the single-device engine on the real prefix."""
+    from hivemall_tpu.core.engine import make_train_step
+    from hivemall_tpu.core.state import init_linear_state
+
+    dims = 1003
+    rng = np.random.RandomState(7)
+    idx = rng.randint(0, dims, size=(3, 16, 8)).astype(np.int32)
+    val = rng.rand(3, 16, 8).astype(np.float32)
+    lab = np.sign(rng.randn(3, 16)).astype(np.float32)
+
+    step = make_train_step(AROW, {"r": 0.1}, donate=False)
+    ref = init_linear_state(dims, use_covariance=True)
+    for i in range(3):
+        ref, _ = step(ref, idx[i], val[i], lab[i])
+    ref = jax.device_get(ref)
+
+    trainer = ShardedTrainer(AROW, {"r": 0.1}, dims, make_mesh(8))
+    assert trainer.dims_padded == 1008 and trainer.stripe == 126
+    state = trainer.init()
+    for i in range(3):
+        state, _ = trainer.step(state, idx[i], val[i], lab[i])
+    got = trainer.final_state(state)  # unpads back to [dims]
+    assert got.weights.shape == (dims,)
+    np.testing.assert_allclose(np.asarray(got.weights), ref.weights,
+                               rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got.covars), ref.covars,
+                               rtol=2e-5, atol=1e-6)
+
+    # the trained sharded state serves directly (weak #5: one placement)
+    predict = trainer.make_predict()
+    got_scores = np.asarray(predict(state, idx[0][:4], val[0][:4]))
+    want = (np.asarray(ref.weights)[idx[0][:4]] * val[0][:4]).sum(axis=-1)
+    np.testing.assert_allclose(got_scores, want, rtol=2e-5, atol=1e-6)
