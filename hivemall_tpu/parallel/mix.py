@@ -218,6 +218,14 @@ class MixConfig:
     # (ref: mixserv/.../MixServerHandler.java:142-148). Each step() call's
     # per-device blocks are consumed in groups of `mix_every`, with one
     # collective mix after each group.
+    #
+    # Cadence matters for covariance learners: every argminKLD mix REPLACES
+    # the covariance with the combined precision 1/sum(1/cov) — the
+    # reference's own reply semantics (PartialArgminKLD.java:43-63) — so
+    # mixing after every block shrinks it ~n_dev-fold per block and freezes
+    # the learner early. The reference's default effective cadence is tens
+    # of updates between mixes (threshold 3 x syncThreshold 30); pick
+    # mix_every on that order for argminKLD runs, not 1.
     mix_every: int = 1
     reduction: str = "auto"  # average | argmin_kld | auto (covariance -> argmin_kld,
     # mirroring the reference's event selection for covariance learners)

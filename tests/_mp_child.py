@@ -1,0 +1,77 @@
+"""Child body for the true multi-process distributed test (not a pytest
+file — spawned by tests/test_multiprocess.py with HIVEMALL_TPU_* env set).
+
+Each process: join the cluster through runtime.cluster.init_cluster, train a
+MixTrainer over the GLOBAL 2-process x 2-device mesh on identical seeded
+blocks, allgather the mixed weights, train its shard of a random forest, and
+dump everything for the parent to cross-check — the loopback analog of the
+reference's in-process MixServer + real MixClient tests
+(ref: mixserv/src/test/java/hivemall/mix/server/MixServerTest.java:46-167).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    out_dir = sys.argv[1]
+
+    from hivemall_tpu.runtime.cluster import cluster_env, init_cluster
+
+    joined = init_cluster()  # reads HIVEMALL_TPU_COORDINATOR/_NUM_PROCS/_PROC_ID
+    assert joined, "init_cluster did not join"
+
+    import jax
+
+    pid = jax.process_index()
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()  # 2 procs x 2 local cpu devs
+
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.parallel import MixConfig, MixTrainer, make_mesh
+
+    dims, n_dev, k, B, K = 256, 4, 2, 16, 8
+    mesh = make_mesh()  # the global 4-device mesh
+    trainer = MixTrainer(AROW, {"r": 0.1}, dims, mesh,
+                         MixConfig(mix_every=2))
+    state = trainer.init()
+    rng = np.random.RandomState(7)  # identical global blocks on every process
+    for _ in range(3):
+        idx = rng.randint(0, dims, size=(n_dev, k, B, K)).astype(np.int32)
+        val = rng.rand(n_dev, k, B, K).astype(np.float32)
+        lab = np.sign(rng.randn(n_dev, k, B)).astype(np.float32)
+        state, loss = trainer.step(state, idx, val, lab)
+
+    from jax.experimental import multihost_utils
+
+    weights = np.asarray(multihost_utils.process_allgather(state.weights,
+                                                           tiled=True))
+    covars = np.asarray(multihost_utils.process_allgather(state.covars,
+                                                          tiled=True))
+
+    # forest shard: each process grows its trees on its data partition
+    from hivemall_tpu.parallel.forest_shard import train_randomforest_sharded
+
+    frng = np.random.RandomState(100 + pid)  # per-process data partition
+    Xp = frng.randn(200, 5).astype(np.float32)
+    yp = (Xp[:, 0] + Xp[:, 1] > 0).astype(np.int64)
+    forest = train_randomforest_sharded(Xp, [str(c) for c in yp],
+                                        "-trees 6 -depth 4 -seed 11",
+                                        process_index=pid, process_count=2,
+                                        classes=["0", "1"])
+    rows = forest.model_rows()
+
+    np.savez(os.path.join(out_dir, f"proc{pid}.npz"),
+             weights=weights, covars=covars, loss=float(loss))
+    with open(os.path.join(out_dir, f"rows{pid}.json"), "w") as f:
+        json.dump([[r[0], r[1], r[2]] for r in rows], f)
+    print(f"CHILD {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
