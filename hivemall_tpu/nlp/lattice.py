@@ -1,0 +1,163 @@
+"""Lattice (Viterbi) Japanese morphological segmenter.
+
+The same algorithm Kuromoji runs over IPADic (build a word lattice over the
+sentence from dictionary hits + unknown-word candidates, pick the min-cost
+path with Viterbi; ref: KuromojiUDF's Lucene JapaneseTokenizer,
+nlp/src/main/java/hivemall/nlp/tokenizer/KuromojiUDF.java:55-86), scaled to
+the bundled lexicon (nlp/lexicon_ja.py):
+
+- dictionary nodes: every lexicon surface matching at each position;
+- unknown-word nodes: maximal same-character-class runs (kanji runs also at
+  lengths 1..4 so lexicalized splits can win), priced above lexicon entries
+  per MeCab's unknown-word model;
+- path cost = word costs + POS-bigram connection costs (a small hand-tuned
+  matrix standing in for IPADic's full 1316^2 connection table).
+
+Pure host-side code, like the reference's JVM analyzer — tokenization feeds
+the feature pipeline (tf/feature_hashing) and never touches the device.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .lexicon_ja import AUX, ADJ, ADV, CONJ, N, P, PRE, V, build_lexicon
+
+_UNK_KANJI = "名詞"      # unknown kanji run -> noun (IPADic unk model)
+_UNK_KATA = "名詞"       # katakana run -> noun (loanword)
+_UNK_HIRA = "動詞"       # unknown hiragana run -> most often a verb chunk
+_UNK_LATIN = "名詞"
+_UNK_NUM = "名詞"
+
+# connection costs: (left_pos, right_pos) -> cost. Negative = favored.
+_CONN: Dict[Tuple[str, str], int] = {
+    (N, P): -150,        # noun + particle: the backbone of Japanese syntax
+    (V, AUX): -250,      # verb stem + auxiliary (食べ+た, 書き+ます)
+    (ADJ, AUX): -150,    # 高かっ+た
+    (AUX, AUX): -100,    # まし+た, なかっ+た
+    (P, V): -50,         # particle then verb
+    (P, N): -50,
+    (PRE, N): -150,      # この+人
+    (N, AUX): -50,       # noun + copula です/だ
+    (N, N): 150,         # discourage spurious noun-noun splits vs compounds
+    (P, P): 100,         # two particles in a row happens (には) but rarer
+    (AUX, N): 100,
+    (V, V): 200,
+}
+
+_BOS = "BOS"
+
+
+def _char_class(ch: str) -> str:
+    o = ord(ch)
+    if 0x3040 <= o <= 0x309F:
+        return "hira"
+    if 0x30A0 <= o <= 0x30FF or o == 0x30FC:
+        return "kata"
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF or o == 0x3005:  # 々
+        return "kanji"
+    if ch.isdigit():
+        return "num"
+    if ch.isalnum():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "punct"
+
+
+# unknown-word pricing: (base, per_char). Above lexicon costs so dictionary
+# analyses win; hiragana steepest (function words must come from the lexicon).
+_UNK_COST = {
+    "kanji": (900, 900),
+    "kata": (700, 250),
+    "hira": (1200, 1800),
+    "latin": (600, 100),
+    "num": (600, 100),
+}
+
+_UNK_POS = {"kanji": _UNK_KANJI, "kata": _UNK_KATA, "hira": _UNK_HIRA,
+            "latin": _UNK_LATIN, "num": _UNK_NUM}
+
+
+class LatticeTokenizer:
+    """Viterbi over dictionary + unknown-word lattice. Returns
+    (surface, pos) pairs; punctuation/space are path breaks (the Lucene
+    analyzer likewise drops punctuation)."""
+
+    def __init__(self, lexicon: Optional[Dict[str, List[Tuple[str, int]]]] = None):
+        self.lexicon = lexicon if lexicon is not None else build_lexicon()
+        self.max_word = max(len(s) for s in self.lexicon)
+
+    def tokenize(self, text: str) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        # segment at space/punct boundaries; lattice runs per segment
+        seg = ""
+        for ch in text:
+            if _char_class(ch) in ("space", "punct"):
+                if seg:
+                    out.extend(self._viterbi(seg))
+                    seg = ""
+            else:
+                seg += ch
+        if seg:
+            out.extend(self._viterbi(seg))
+        return out
+
+    def _candidates(self, s: str, i: int) -> List[Tuple[str, str, int]]:
+        """(surface, pos, word_cost) candidates starting at position i."""
+        cands: List[Tuple[str, str, int]] = []
+        # dictionary hits
+        for L in range(1, min(self.max_word, len(s) - i) + 1):
+            surf = s[i : i + L]
+            for pos, cost in self.lexicon.get(surf, ()):
+                cands.append((surf, pos, cost))
+        # unknown-word candidates over the same-class run
+        cls = _char_class(s[i])
+        run = 1
+        while i + run < len(s) and _char_class(s[i + run]) == cls:
+            run += 1
+        base, per = _UNK_COST[cls]
+        pos = _UNK_POS[cls]
+        if cls in ("kata", "latin", "num"):
+            lengths = [run]  # whole run: loanwords/numbers don't split
+        elif cls == "kanji":
+            lengths = list(range(1, min(run, 4) + 1))
+            if run > 4:
+                lengths.append(run)
+        else:  # hira
+            lengths = list(range(1, min(run, 3) + 1))
+        for L in lengths:
+            surf = s[i : i + L]
+            if any(c[0] == surf for c in cands):
+                continue  # lexicon entry already covers this surface
+            cands.append((surf, pos, base + per * L))
+        return cands
+
+    def _viterbi(self, s: str) -> List[Tuple[str, str]]:
+        n = len(s)
+        INF = 1 << 60
+        # best[i] = (cost, prev_index, surface, pos) reaching position i
+        best: List[Tuple[int, int, str, str]] = [(INF, -1, "", "")] * (n + 1)
+        best[0] = (0, -1, "", _BOS)
+        for i in range(n):
+            cost_i, _, _, pos_i = best[i]
+            if cost_i >= INF:
+                continue
+            for surf, pos, wcost in self._candidates(s, i):
+                j = i + len(surf)
+                conn = 0 if pos_i == _BOS else _CONN.get((pos_i, pos), 0)
+                total = cost_i + wcost + conn
+                if total < best[j][0]:
+                    best[j] = (total, i, surf, pos)
+        # backtrack
+        toks: List[Tuple[str, str]] = []
+        i = n
+        while i > 0:
+            _, prev, surf, pos = best[i]
+            if prev < 0:
+                # unreachable (shouldn't happen: 1-char unknowns always exist)
+                return [(s, _UNK_POS.get(_char_class(s[0]), N))]
+            toks.append((surf, pos))
+            i = prev
+        toks.reverse()
+        return toks

@@ -1,0 +1,216 @@
+"""Built-in Japanese lexicon for the lattice tokenizer (nlp/lattice.py).
+
+A compact IPADic-style morpheme inventory — function words enumerated, verb
+and adjective inflections GENERATED from stems by conjugation class — so the
+in-image `tokenize_ja` default is a real morphological analyzer rather than
+a character-class splitter (parity target: KuromojiUDF NORMAL mode,
+ref: nlp/src/main/java/hivemall/nlp/tokenizer/KuromojiUDF.java:55-86, whose
+Lucene JapaneseTokenizer consults the bundled IPADic the same way).
+
+Granularity matches IPADic: inflected predicates split stem + auxiliaries
+(食べました -> 食べ/まし/た), particles are single morphemes, compounds stay
+whole when lexicalized. Costs are hand-scaled integers: lower = preferred;
+the unknown-word models in lattice.py are priced above lexicon entries so
+known analyses win.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+# POS tags (IPADic top-level)
+N = "名詞"          # noun
+P = "助詞"          # particle
+AUX = "助動詞"      # auxiliary verb
+V = "動詞"          # verb
+ADJ = "形容詞"      # i-adjective
+ADV = "副詞"        # adverb
+CONJ = "接続詞"     # conjunction
+PRE = "連体詞"      # prenominal
+PRON = "名詞"       # pronouns filed as nouns, like IPADic 名詞-代名詞
+SYM = "記号"        # symbol
+
+_PARTICLES = [
+    # 格助詞 / 係助詞 / 接続助詞 / 終助詞 / 副助詞
+    "が", "を", "に", "で", "と", "へ", "から", "まで", "より", "の",
+    "は", "も", "こそ", "さえ", "しか", "だけ", "ほど", "くらい", "ぐらい",
+    "など", "なら", "ば", "ながら", "つつ", "ので", "のに", "けど", "けれど",
+    "けれども", "か", "ね", "よ", "な", "わ", "ぞ", "や", "とか", "って",
+]
+
+_AUXILIARIES = [
+    # copulas + inflecting auxiliaries, IPADic-style split units
+    "です", "でした", "でしょう", "だ", "だった", "だろう", "である",
+    "ます", "まし", "ませ", "ましょう", "た", "て", "で",
+    "ない", "なかっ", "なく", "ぬ", "ん", "う", "よう",
+    "れる", "られる", "れ", "られ", "せる", "させる", "せ", "させ",
+    "たい", "たかっ", "そう", "らしい", "みたい", "べき", "ちゃ", "じゃ",
+]
+
+_NOUNS = [
+    # pronouns / demonstratives
+    "私", "僕", "俺", "彼", "彼女", "誰", "何", "これ", "それ", "あれ",
+    "どれ", "ここ", "そこ", "あそこ", "どこ", "こちら", "そちら",
+    # time
+    "今日", "明日", "昨日", "今", "今年", "去年", "来年", "毎日", "朝",
+    "昼", "夜", "時間", "時", "年", "月", "日", "週", "分", "秒", "午前",
+    "午後",
+    # common concrete/abstract
+    "人", "人間", "子供", "男", "女", "友達", "家族", "先生", "学生",
+    "日本", "日本語", "英語", "東京", "京都", "世界", "国", "町", "村",
+    "学校", "大学", "会社", "仕事", "電話", "映画", "音楽", "写真",
+    "本", "新聞", "手紙", "名前", "言葉", "話", "意味", "問題", "質問",
+    "答え", "勉強", "研究", "旅行", "買い物", "料理", "食事", "朝食",
+    "昼食", "夕食", "水", "お茶", "御飯", "ご飯", "肉", "魚", "野菜",
+    "寿司", "犬", "猫", "鳥", "花", "木", "山", "川", "海", "空", "雨",
+    "雪", "風", "天気", "車", "電車", "自転車", "飛行機", "駅", "道",
+    "家", "部屋", "店", "お金", "金", "手", "足", "目", "耳", "口",
+    "頭", "体", "心", "気", "声", "色", "形", "数", "前", "後", "上",
+    "下", "中", "外", "間", "こと", "もの", "ところ", "とき", "ため",
+    "ほう", "方", "的", "さん", "君", "様", "機械", "学習", "計算",
+    "情報", "技術",
+]
+
+_MISC_VERBS = [  # polite/formulaic chunks, IPADic-style single units
+    "ください", "下さい", "いただき", "いただく", "くれ", "くれる",
+    "もらい", "もらう", "あげる", "あり", "ある", "あっ", "なり", "なる",
+    "なっ", "思い", "思っ", "言い", "言っ", "行っ", "来まし",
+]
+
+_INTERJECTIONS = ["ありがとう", "こんにちは", "こんばんは", "おはよう",
+                  "すみません", "さようなら", "はい", "いいえ"]
+
+_ADVERBS = [
+    "とても", "すごく", "少し", "ちょっと", "たくさん", "もっと", "また",
+    "まだ", "もう", "すぐ", "いつも", "時々", "よく", "あまり", "全然",
+    "きっと", "たぶん", "やはり", "やっぱり", "一緒に", "ゆっくり",
+]
+
+_CONJUNCTIONS = ["そして", "しかし", "でも", "だから", "それで", "また",
+                 "それから", "つまり", "例えば"]
+
+_PRENOMINALS = ["この", "その", "あの", "どの", "大きな", "小さな", "同じ"]
+
+# (stem, class) — ichidan drops る; godan conjugates by final kana row;
+# suru/kuru irregular listed explicitly below
+_ICHIDAN = ["食べ", "見", "出", "寝", "起き", "着", "開け", "閉め", "教え",
+            "覚え", "忘れ", "考え", "伝え", "感じ", "信じ", "調べ", "続け",
+            "始め", "止め", "決め", "入れ", "届け", "受け", "助け", "逃げ",
+            "投げ", "見せ", "乗せ", "任せ", "い", "でき", "生き", "着け"]
+
+_GODAN = [  # (stem-without-final, final dictionary kana)
+    ("書", "く"), ("行", "く"), ("聞", "く"), ("歩", "く"), ("働", "く"),
+    ("泳", "ぐ"), ("急", "ぐ"), ("話", "す"), ("出", "す"), ("返", "す"),
+    ("待", "つ"), ("持", "つ"), ("立", "つ"), ("勝", "つ"), ("死", "ぬ"),
+    ("遊", "ぶ"), ("呼", "ぶ"), ("飛", "ぶ"), ("読", "む"), ("飲", "む"),
+    ("住", "む"), ("休", "む"), ("頼", "む"), ("作", "る"), ("乗", "る"),
+    ("取", "る"), ("帰", "る"), ("走", "る"), ("入", "る"), ("分か", "る"),
+    ("終わ", "る"), ("始ま", "る"), ("売", "る"), ("降", "る"), ("曲が", "る"),
+    ("買", "う"), ("会", "う"), ("使", "う"), ("思", "う"), ("言", "う"),
+    ("習", "う"), ("歌", "う"), ("洗", "う"), ("笑", "う"), ("手伝", "う"),
+]
+
+_I_ADJ_STEMS = ["大き", "小さ", "新し", "古", "高", "安", "良", "悪", "早",
+                "遅", "暑", "寒", "熱", "冷た", "美し", "おいし", "うま",
+                "難し", "易し", "面白", "楽し", "嬉し", "悲し", "忙し",
+                "近", "遠", "長", "短", "強", "弱", "多", "少な", "白",
+                "黒", "赤", "青", "明る", "暗", "若"]
+
+# godan conjugation rows: final kana -> (a, i, e, o, onbin-ta-form)
+_GODAN_ROWS = {
+    "く": ("か", "き", "け", "こ", "いた"),
+    "ぐ": ("が", "ぎ", "げ", "ご", "いだ"),
+    "す": ("さ", "し", "せ", "そ", "した"),
+    "つ": ("た", "ち", "て", "と", "った"),
+    "ぬ": ("な", "に", "ね", "の", "んだ"),
+    "ぶ": ("ば", "び", "べ", "ぼ", "んだ"),
+    "む": ("ま", "み", "め", "も", "んだ"),
+    "る": ("ら", "り", "れ", "ろ", "った"),
+    "う": ("わ", "い", "え", "お", "った"),
+}
+
+_COSTS = {P: 100, AUX: 150, CONJ: 300, V: 350, N: 400, ADJ: 400, ADV: 450,
+          PRE: 350}
+
+
+def _verb_forms() -> List[Tuple[str, str, int]]:
+    out = []
+    seen = set()
+
+    def add(surface, cost_bump=0):
+        if surface and surface not in seen:
+            seen.add(surface)
+            out.append((surface, V, _COSTS[V] + cost_bump))
+
+    for stem in _ICHIDAN:
+        add(stem + "る")   # dictionary
+        add(stem)          # 連用/未然 (combines with ます/た/ない/て)
+        add(stem + "れ", 50)   # 仮定
+        add(stem + "ろ", 80)   # imperative
+    for stem, fin in _GODAN:
+        a, i, e, o, onbin = _GODAN_ROWS[fin]
+        add(stem + fin)        # dictionary 書く
+        add(stem + i)          # 連用 書き (+ます)
+        add(stem + a, 30)      # 未然 書か (+ない/れる)
+        add(stem + e, 50)      # 仮定/命令 書け
+        add(stem + o, 80)      # 意向 書こ (+う)
+        add(stem + onbin[:-1], 20)  # 音便 stem 書い/読ん (+た/だ handled as AUX た/で)
+        add(stem + onbin, 40)  # fused 書いた/読んだ as single verb token fallback
+    # irregulars
+    for f in ("する", "し", "さ", "すれ", "しろ", "せよ"):
+        add(f)
+    add("来る")
+    add("来", 60)
+    add("くる", 60)
+    # kana 来る stems collide with everyday words (き=木/気, こ=子, これ the
+    # pronoun) — priced well above them so they only win next to auxiliaries
+    # when nothing else parses
+    add("き", 300)
+    add("こ", 400)
+    return out
+
+
+def _adj_forms() -> List[Tuple[str, str, int]]:
+    out = []
+    for stem in _I_ADJ_STEMS:
+        out.append((stem + "い", ADJ, _COSTS[ADJ]))
+        out.append((stem + "く", ADJ, _COSTS[ADJ] + 30))
+        out.append((stem + "かっ", ADJ, _COSTS[ADJ] + 30))  # +た
+        out.append((stem + "けれ", ADJ, _COSTS[ADJ] + 60))  # +ば
+        out.append((stem + "さ", N, _COSTS[N] + 80))        # nominalization
+    out.append(("いい", ADJ, _COSTS[ADJ]))
+    out.append(("よく", ADJ, _COSTS[ADJ] + 30))
+    return out
+
+
+def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
+    """surface -> [(pos, cost), ...] (a surface may be ambiguous, e.g. で as
+    particle and auxiliary; の as particle and nominalizer)."""
+    lex: Dict[str, List[Tuple[str, int]]] = {}
+
+    def add(surface, pos, cost):
+        lex.setdefault(surface, [])
+        if all(p != pos for p, _ in lex[surface]):
+            lex[surface].append((pos, cost))
+
+    for w in _PARTICLES:
+        add(w, P, _COSTS[P] + (len(w) - 1) * 20)
+    for w in _AUXILIARIES:
+        add(w, AUX, _COSTS[AUX] + (len(w) - 1) * 20)
+    for w in _NOUNS:
+        add(w, N, _COSTS[N])
+    for w in _ADVERBS:
+        add(w, ADV, _COSTS[ADV])
+    for w in _CONJUNCTIONS:
+        add(w, CONJ, _COSTS[CONJ])
+    for w in _PRENOMINALS:
+        add(w, PRE, _COSTS[PRE])
+    for w in _MISC_VERBS:
+        add(w, V, _COSTS[V])
+    for w in _INTERJECTIONS:
+        add(w, "感動詞", 300)
+    for surface, pos, cost in _verb_forms():
+        add(surface, pos, cost)
+    for surface, pos, cost in _adj_forms():
+        add(surface, pos, cost)
+    return lex

@@ -4,11 +4,13 @@ Mirrors KuromojiUDF (ref: nlp/src/main/java/hivemall/nlp/tokenizer/KuromojiUDF.j
 `tokenize_ja(text [, mode [, stopwords [, stoptags]]])` with mode
 NORMAL/SEARCH/EXTENDED, a stopword list, and POS stoptag filtering.
 
-Backend resolution: a real morphological analyzer (fugashi/MeCab, janome, or
-SudachiPy) is used when installed; otherwise a character-class segmenter
-(kanji/kana/latin run boundaries — the standard analyzer-free fallback)
-stands in so the function is always callable. POS stoptags only apply when a
-morphological backend provides POS tags.
+Backend resolution: an external morphological analyzer (fugashi/MeCab or
+janome) is used when installed; otherwise the BUILT-IN lattice analyzer
+(nlp/lattice.py — Viterbi over the bundled lexicon + unknown-word models,
+the same algorithm Kuromoji runs over IPADic) is the default, so the
+in-image behavior is always morphological, with POS tags for stoptag
+filtering. The character-class segmenter (_charclass_tokenize) remains as a
+library function for callers that want raw script-run splitting.
 """
 
 from __future__ import annotations
@@ -16,6 +18,8 @@ from __future__ import annotations
 import re
 import unicodedata
 from typing import List, Optional, Sequence
+
+from .lattice import _char_class
 
 _BACKEND = None
 _BACKEND_NAME = "charclass"
@@ -41,23 +45,11 @@ def _resolve_backend():
         return _BACKEND
     except ImportError:
         pass
-    _BACKEND = False
+    from .lattice import LatticeTokenizer
+
+    _BACKEND = LatticeTokenizer()
+    _BACKEND_NAME = "lattice"
     return _BACKEND
-
-
-def _char_class(ch: str) -> str:
-    o = ord(ch)
-    if 0x3040 <= o <= 0x309F:
-        return "hira"
-    if 0x30A0 <= o <= 0x30FF or o == 0x30FC:
-        return "kata"
-    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF:
-        return "kanji"
-    if ch.isalnum():
-        return "latin"
-    if ch.isspace():
-        return "space"
-    return "punct"
 
 
 def _charclass_tokenize(text: str) -> List[str]:
@@ -97,8 +89,15 @@ def tokenize_ja(text: str, mode: str = "normal",
     text = unicodedata.normalize("NFKC", text)
     backend = _resolve_backend()
     tokens: List[str] = []
-    if backend is False:
-        tokens = _charclass_tokenize(text)
+    if _BACKEND_NAME == "lattice":
+        # Kuromoji stoptags are hierarchical ("助詞-格助詞"); the built-in
+        # lattice carries top-level POS, so hierarchical tags collapse to
+        # their top level here
+        stop_top = {t.split("-")[0] for t in (stoptags or ())}
+        for surface, pos in backend.tokenize(text):
+            if pos in stop_top:
+                continue
+            tokens.append(surface)
     elif _BACKEND_NAME == "fugashi":
         stop_pos = set(stoptags or [])
         for word in backend(text):

@@ -62,6 +62,41 @@ class TestNlp:
         toks = tokenize_ja("JAXで機械学習2026")
         assert any("JAX" in t for t in toks)
 
+    def test_tokenize_ja_is_morphological_not_charclass(self):
+        """The in-image default backend must segment morphologically
+        (KuromojiUDF NORMAL parity target): これはペンです contains the
+        hiragana run これはです-pieces that a character-class splitter can
+        only emit fused (これは / です), while a morphological analyzer
+        separates the pronoun from the topic particle."""
+        from hivemall_tpu.nlp.tokenizer import _charclass_tokenize, backend_name
+
+        assert backend_name() in ("lattice", "fugashi", "janome")
+        toks = tokenize_ja("これはペンです")
+        assert toks == ["これ", "は", "ペン", "です"], toks
+        # the charclass fallback provably cannot do this: it fuses the
+        # pronoun with the topic particle (one hiragana run)
+        assert _charclass_tokenize("これはペンです")[0] == "これは"
+
+        toks = tokenize_ja("東京で寿司を食べた")
+        assert toks == ["東京", "で", "寿司", "を", "食べ", "た"], toks
+        # charclass fuses the verb stem's kanji with the auxiliary kana
+        assert "食べ" not in _charclass_tokenize("東京で寿司を食べた")
+
+    def test_tokenize_ja_ipadic_granularity(self):
+        """Inflected predicates split stem + auxiliaries like IPADic
+        (読みました -> 読み/まし/た)."""
+        toks = tokenize_ja("彼女は新しい本を読みました")
+        assert toks == ["彼女", "は", "新しい", "本", "を", "読み", "まし",
+                        "た"], toks
+
+    def test_tokenize_ja_stoptags_filter_pos(self):
+        """POS stoptags drop particles/auxiliaries (the classic Kuromoji
+        stoptag use), keeping content morphemes."""
+        toks = tokenize_ja("私は日本語を勉強しています", "normal", None,
+                           ["助詞", "助動詞"])
+        assert "は" not in toks and "を" not in toks and "ます" not in toks
+        assert "私" in toks and "日本語" in toks and "勉強" in toks
+
 
 class TestAdapters:
     def _df(self):

@@ -122,6 +122,28 @@ class TestExportAndVM:
         for i in range(0, 40):
             assert T.tree_predict("json", t.model, X[i]) == direct[i]
 
+    def test_javascript_evaluator_matches_vm(self):
+        """The third evaluator (the Rhino analog, TreePredictUDF.java:326):
+        compile the emitted javascript and match the StackMachine tree for
+        tree per row — classification and regression leaves."""
+        X, y = _gen_classification(n=300)
+        fjs = T.train_randomforest_classifier(
+            X, y, "-trees 3 -seed 2 -output javascript")
+        fop = T.train_randomforest_classifier(
+            X, y, "-trees 3 -seed 2 -output opscode")
+        rng = np.random.RandomState(5)
+        Xt = X[rng.choice(len(X), 40, replace=False)]
+        for t_js, t_op in zip(fjs.trees, fop.trees):
+            for x in Xt:
+                assert T.tree_predict("javascript", t_js.model, x) == \
+                    T.tree_predict("opscode", t_op.model, x)
+
+    def test_javascript_evaluator_rejects_non_grammar(self):
+        with pytest.raises(ValueError, match="javascript tree"):
+            T.tree_predict("javascript", "alert('hi');", [0.0])
+        with pytest.raises(ValueError, match="javascript tree"):
+            T.tree_predict("javascript", "if (x[0] <= 1) { 0; }", [0.0])
+
     def test_stack_machine_basics(self):
         # hand-written script: x[0] <= 0.5 -> 0 else 1 (the reference VM
         # grammar: true branch falls through, ifle jumps to false branch)
