@@ -133,7 +133,17 @@ def _row_predict(state: FFMState, idx, val, fields, hyper: FFMHyper):
     return p, keys, Vg, xx
 
 
-def make_ffm_step(hyper: FFMHyper, mode: str = "scan"):
+def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
+                  row_chunk: Optional[int] = None):
+    """`row_chunk` (minibatch mode only) tiles the batch's K^2 pairwise work:
+    the [B, K, K, k] dV / [B, K, K] gg activations are the FFM memory hot
+    spot (256MB at B=16384, K=32, k=4 — grows with the square of the field
+    count), so the batch is processed in chunks of `row_chunk` rows — every
+    chunk computes against the SAME block-start parameters (identical
+    accumulate-then-apply semantics, tested exact vs unchunked) and
+    scatter-adds into the carried tables, bounding peak activation memory at
+    [row_chunk, K, K, k]."""
+
     def dloss_fn(p, y):
         if hyper.classification:
             z = p * y
@@ -238,7 +248,61 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan"):
             jnp.ones_like(indices, dtype=jnp.int8), mode="drop")
         return st.replace(touched=touched), jnp.sum(loss)
 
-    return jax.jit(scan_step if mode == "scan" else minibatch_step, donate_argnums=(0,))
+    def chunked_minibatch_step(state: FFMState, indices, values, fields, labels):
+        b = indices.shape[0]
+        c = row_chunk
+        if b % c != 0:
+            raise ValueError(f"batch {b} not divisible by row_chunk {c}")
+        chunks = jax.tree.map(
+            lambda a: a.reshape((b // c, c) + a.shape[1:]),
+            (indices, values, fields, labels))
+        ts_all = (state.step + 1 + jnp.arange(b)).astype(jnp.float32) \
+            .reshape(b // c, c)
+
+        def body(st, chunk_in):
+            idx, val, fld, lab, ts = chunk_in
+            # updates computed against the ORIGINAL block-start `state`
+            # (closure), scatters accumulate into the carried tables — the
+            # same accumulate-then-apply semantics as the unchunked path
+            p, g, loss, keys, dV, dgg = jax.vmap(
+                lambda i, v, f, y, t: row_updates(state, i, v, f, y, t))(
+                    idx, val, fld, lab, ts)
+            k = dV.shape[-1]
+            st = st.replace(
+                v=st.v.at[keys.reshape(-1)].add(dV.reshape(-1, k)),
+                v_gg=st.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1)),
+            )
+            if hyper.linear_coeff:
+                dz, dn, w_new = jax.vmap(
+                    lambda i, v_, g_, t: w_updates(state, i, v_, g_, t))(
+                        idx, val, g, ts)
+                st = st.replace(
+                    z=st.z.at[idx].add(dz, mode="drop"),
+                    n=st.n.at[idx].add(dn, mode="drop"),
+                    w=st.w.at[idx].set(w_new, mode="drop"),
+                )
+            st = st.replace(touched=st.touched.at[idx].max(
+                jnp.ones_like(idx, dtype=jnp.int8), mode="drop"))
+            return st, (jnp.sum(loss), jnp.sum(g))
+
+        st, (losses, g_sums) = jax.lax.scan(body, state, (*chunks, ts_all))
+        if hyper.global_bias:
+            # one batch-level w0 update with eta at the batch's final
+            # timestep — identical to the unchunked path, not per-chunk
+            eta = hyper.eta.eta(ts_all[-1, -1])
+            st = st.replace(w0=state.w0 - eta * (
+                jnp.sum(g_sums) + b * 2.0 * hyper.lambda_w * state.w0))
+        return st.replace(step=state.step + b), jnp.sum(losses)
+
+    if row_chunk is not None and mode != "minibatch":
+        raise ValueError("row_chunk applies to minibatch mode only")
+    if mode == "scan":
+        fn = scan_step
+    elif row_chunk is not None:
+        fn = chunked_minibatch_step
+    else:
+        fn = minibatch_step
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 def _ffm_scores(state: FFMState, hyper: FFMHyper, indices, values, fields):
@@ -313,6 +377,9 @@ def _ffm_options() -> Options:
     o.add("lambda2", None, True, "FTRL L2 [default 0.01]", default=0.01, type=float)
     o.add("v_bits", None, True, "log2 size of the hashed V table [default 22]",
           default=22, type=int)
+    o.add("row_chunk", None, True,
+          "Tile minibatch K^2 pairwise work in chunks of this many rows "
+          "(bounds activation memory; 0 = no tiling)", default=0, type=int)
     return o
 
 
@@ -346,7 +413,18 @@ def train_ffm(rows: Sequence[Sequence[str]], labels, options: Optional[str] = No
     mini_batch = cl.get_int("mini_batch", 1)
     mode = "minibatch" if mini_batch > 1 else "scan"
     block = mini_batch if mode == "minibatch" else cl.get_int("block_size", 4096)
-    step = make_ffm_step(hyper, mode)
+    row_chunk = cl.get_int("row_chunk", 0) or None
+    if row_chunk is not None:
+        if mode != "minibatch":
+            raise ValueError("-row_chunk requires -mini_batch > 1 "
+                             "(it tiles the minibatch pairwise work)")
+        if block % row_chunk != 0:
+            raise ValueError(
+                f"-mini_batch {block} not divisible by -row_chunk {row_chunk}")
+    step = make_ffm_step(hyper, mode, row_chunk=row_chunk)
+    # the trailing partial block (n % block rows) won't divide by row_chunk;
+    # it goes through an untiled step (same semantics, small shape)
+    tail_step = make_ffm_step(hyper, mode) if row_chunk is not None else step
     state = init_ffm_state(hyper)
     iters = cl.get_int("iters", 1)
     conv = ConversionState(not cl.has("disable_cv"), cl.get_float("cv_rate", 0.005))
@@ -355,7 +433,9 @@ def train_ffm(rows: Sequence[Sequence[str]], labels, options: Optional[str] = No
         epoch_loss = 0.0
         for s in range(0, n, block):
             e = min(s + block, n)
-            state, loss = step(state, idx[s:e], val[s:e], fld[s:e], lab[s:e])
+            use = step if (row_chunk is None or (e - s) % row_chunk == 0) \
+                else tail_step
+            state, loss = use(state, idx[s:e], val[s:e], fld[s:e], lab[s:e])
             epoch_loss += float(loss)
         conv.incr_loss(epoch_loss)
         if iters > 1 and conv.is_converged(n):

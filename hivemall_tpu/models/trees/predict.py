@@ -8,10 +8,13 @@ from typing import List, Sequence, Union
 
 import numpy as np
 
+import re
+
 from .export import eval_json_tree
 from .vm import StackMachine
 
-_JS_TOKEN = None  # compiled lazily (regex import cost)
+_JS_TOKEN = re.compile(
+    r"\s*(if|else|x\[(\d+)\]|<=|==|[(){};]|-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)")
 
 
 def compile_js_tree(source: str):
@@ -22,12 +25,6 @@ def compile_js_tree(source: str):
     (ref: smile/tools/TreePredictUDF.java:326). The emitted grammar is a
     closed expression subset, so a recursive-descent parser replaces the JS
     engine off-JVM; anything outside the grammar is a loud ValueError."""
-    import re
-
-    global _JS_TOKEN
-    if _JS_TOKEN is None:
-        _JS_TOKEN = re.compile(
-            r"\s*(if|else|x\[(\d+)\]|<=|==|[(){};]|-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)")
     tokens: List = []
     pos = 0
     while pos < len(source):

@@ -57,7 +57,12 @@ def _charclass_tokenize(text: str) -> List[str]:
     cur = ""
     cur_cls = None
     for ch in text:
+        # digits group with latin here (historical raw-run behavior:
+        # "JAX2026" stays one token), unlike the lattice's own unknown-word
+        # model which prices digit runs separately
         cls = _char_class(ch)
+        if cls == "num":
+            cls = "latin"
         if cls in ("space", "punct"):
             if cur:
                 tokens.append(cur)
@@ -91,9 +96,11 @@ def tokenize_ja(text: str, mode: str = "normal",
     tokens: List[str] = []
     if _BACKEND_NAME == "lattice":
         # Kuromoji stoptags are hierarchical ("助詞-格助詞"); the built-in
-        # lattice carries top-level POS, so hierarchical tags collapse to
-        # their top level here
-        stop_top = {t.split("-")[0] for t in (stoptags or ())}
+        # lattice carries top-level POS only, so a top-level stoptag filters
+        # that whole class, while a narrower hierarchical tag matches
+        # nothing here (never over-filter an entire class because the user
+        # asked to drop one subtype)
+        stop_top = {t for t in (stoptags or ()) if "-" not in t}
         for surface, pos in backend.tokenize(text):
             if pos in stop_top:
                 continue

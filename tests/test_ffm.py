@@ -44,6 +44,47 @@ def test_ffm_minibatch():
     assert acc > 0.8, acc
 
 
+def test_ffm_row_chunk_exact_vs_unchunked():
+    """The K^2 activation tiling (-row_chunk) must not change the math: the
+    chunked minibatch step computes every row against block-start parameters
+    and accumulates the identical scatters."""
+    import jax
+
+    from hivemall_tpu.models.ffm import (FFMHyper, _stage_ffm_rows,
+                                         init_ffm_state, make_ffm_step)
+
+    rows, y = _gen_ffm_data(n=256)
+    # global_bias on: the w0 update must also match (one batch-level update
+    # with eta at the batch's final timestep, not per-chunk)
+    hyper = FFMHyper(factors=4, num_features=1 << 18, v_dims=1 << 18, seed=3,
+                     global_bias=True)
+    idx, val, fld, lab = _stage_ffm_rows(rows, y, hyper)
+
+    plain = make_ffm_step(hyper, "minibatch")
+    tiled = make_ffm_step(hyper, "minibatch", row_chunk=32)
+    s1, l1 = plain(init_ffm_state(hyper), idx, val, fld, lab)
+    s2, l2 = tiled(init_ffm_state(hyper), idx, val, fld, lab)
+    assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+    h1, h2 = jax.device_get(s1), jax.device_get(s2)
+    np.testing.assert_allclose(h2.v, h1.v, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(h2.v_gg, h1.v_gg, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(h2.w, h1.w, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(h2.z, h1.z, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(h2.n, h1.n, rtol=1e-5, atol=1e-7)
+    assert int(h2.step) == int(h1.step)
+    np.testing.assert_array_equal(h2.touched, h1.touched)
+    assert float(h2.w0) == pytest.approx(float(h1.w0), abs=1e-7)
+
+
+def test_ffm_row_chunk_via_options():
+    rows, y = _gen_ffm_data(n=800)
+    model = FFM.train_ffm(rows, y,
+                          "-factor 4 -iters 20 -feature_hashing 18 -v_bits 18 "
+                          "-lambda0 0.0 -mini_batch 64 -row_chunk 16 -disable_cv")
+    acc = float(np.mean(np.sign(model.predict(rows)) == y))
+    assert acc > 0.8, acc
+
+
 def test_ffm_ftrl_sparsifies_linear_term():
     rows, y = _gen_ffm_data(n=300)
     model = FFM.train_ffm(rows, y,
