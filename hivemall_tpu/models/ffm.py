@@ -222,31 +222,48 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         state, losses = jax.lax.scan(body, state, (indices, values, fields, labels))
         return state, jnp.sum(losses)
 
+    def apply_row_group(carry: FFMState, base: FFMState, idx, val, fld, lab,
+                        ts):
+        """Compute one row group's updates against the block-start `base`
+        parameters and scatter-accumulate them into `carry` — the single
+        accumulate-then-apply body shared by the unchunked minibatch step
+        (carry == base, one group) and the tiled step (scan over groups)."""
+        p, g, loss, keys, dV, dgg = jax.vmap(
+            lambda i, v, f, y, t: row_updates(base, i, v, f, y, t))(
+                idx, val, fld, lab, ts)
+        k = dV.shape[-1]
+        carry = carry.replace(
+            v=carry.v.at[keys.reshape(-1)].add(dV.reshape(-1, k)),
+            v_gg=carry.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1)),
+        )
+        if hyper.linear_coeff:
+            dz, dn, w_new = jax.vmap(
+                lambda i, v_, g_, t: w_updates(base, i, v_, g_, t))(
+                    idx, val, g, ts)
+            carry = carry.replace(
+                z=carry.z.at[idx].add(dz, mode="drop"),
+                n=carry.n.at[idx].add(dn, mode="drop"),
+                w=carry.w.at[idx].set(w_new, mode="drop"),
+            )
+        carry = carry.replace(touched=carry.touched.at[idx].max(
+            jnp.ones_like(idx, dtype=jnp.int8), mode="drop"))
+        return carry, jnp.sum(loss), jnp.sum(g)
+
+    def apply_w0(st: FFMState, base: FFMState, g_sum, b, t_last):
+        # one batch-level w0 update with eta at the batch's final timestep
+        if not hyper.global_bias:
+            return st
+        eta = hyper.eta.eta(t_last)
+        return st.replace(w0=base.w0 - eta * (
+            g_sum + b * 2.0 * hyper.lambda_w * base.w0))
+
     def minibatch_step(state: FFMState, indices, values, fields, labels):
         b = indices.shape[0]
         ts = (state.step + 1 + jnp.arange(b)).astype(jnp.float32)
-        p, g, loss, keys, dV, dgg = jax.vmap(
-            lambda i, v, f, y, t: row_updates(state, i, v, f, y, t))(
-                indices, values, fields, labels, ts)
-        k = dV.shape[-1]
-        v = state.v.at[keys.reshape(-1)].add(dV.reshape(-1, k))
-        v_gg = state.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1))
-        st = state.replace(v=v, v_gg=v_gg, step=state.step + b)
-        if hyper.linear_coeff:
-            dz, dn, w_new = jax.vmap(
-                lambda i, v_, g_, t: w_updates(state, i, v_, g_, t))(
-                    indices, values, g, ts)
-            st = st.replace(
-                z=st.z.at[indices].add(dz, mode="drop"),
-                n=st.n.at[indices].add(dn, mode="drop"),
-                w=st.w.at[indices].set(w_new, mode="drop"),
-            )
-        if hyper.global_bias:
-            eta = hyper.eta.eta(ts[-1])
-            st = st.replace(w0=st.w0 - eta * jnp.sum(g + 2.0 * hyper.lambda_w * state.w0))
-        touched = st.touched.at[indices].max(
-            jnp.ones_like(indices, dtype=jnp.int8), mode="drop")
-        return st.replace(touched=touched), jnp.sum(loss)
+        st, loss, g_sum = apply_row_group(state, state, indices, values,
+                                          fields, labels, ts)
+        st = apply_w0(st, state, g_sum, b, ts[-1])
+        return st.replace(step=state.step + b), loss
 
     def chunked_minibatch_step(state: FFMState, indices, values, fields, labels):
         b = indices.shape[0]
@@ -261,41 +278,18 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
 
         def body(st, chunk_in):
             idx, val, fld, lab, ts = chunk_in
-            # updates computed against the ORIGINAL block-start `state`
-            # (closure), scatters accumulate into the carried tables — the
-            # same accumulate-then-apply semantics as the unchunked path
-            p, g, loss, keys, dV, dgg = jax.vmap(
-                lambda i, v, f, y, t: row_updates(state, i, v, f, y, t))(
-                    idx, val, fld, lab, ts)
-            k = dV.shape[-1]
-            st = st.replace(
-                v=st.v.at[keys.reshape(-1)].add(dV.reshape(-1, k)),
-                v_gg=st.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1)),
-            )
-            if hyper.linear_coeff:
-                dz, dn, w_new = jax.vmap(
-                    lambda i, v_, g_, t: w_updates(state, i, v_, g_, t))(
-                        idx, val, g, ts)
-                st = st.replace(
-                    z=st.z.at[idx].add(dz, mode="drop"),
-                    n=st.n.at[idx].add(dn, mode="drop"),
-                    w=st.w.at[idx].set(w_new, mode="drop"),
-                )
-            st = st.replace(touched=st.touched.at[idx].max(
-                jnp.ones_like(idx, dtype=jnp.int8), mode="drop"))
-            return st, (jnp.sum(loss), jnp.sum(g))
+            st, loss, g_sum = apply_row_group(st, state, idx, val, fld, lab,
+                                              ts)
+            return st, (loss, g_sum)
 
         st, (losses, g_sums) = jax.lax.scan(body, state, (*chunks, ts_all))
-        if hyper.global_bias:
-            # one batch-level w0 update with eta at the batch's final
-            # timestep — identical to the unchunked path, not per-chunk
-            eta = hyper.eta.eta(ts_all[-1, -1])
-            st = st.replace(w0=state.w0 - eta * (
-                jnp.sum(g_sums) + b * 2.0 * hyper.lambda_w * state.w0))
+        st = apply_w0(st, state, jnp.sum(g_sums), b, ts_all[-1, -1])
         return st.replace(step=state.step + b), jnp.sum(losses)
 
     if row_chunk is not None and mode != "minibatch":
         raise ValueError("row_chunk applies to minibatch mode only")
+    if row_chunk is not None and row_chunk <= 0:
+        raise ValueError(f"row_chunk must be positive, got {row_chunk}")
     if mode == "scan":
         fn = scan_step
     elif row_chunk is not None:
@@ -415,6 +409,8 @@ def train_ffm(rows: Sequence[Sequence[str]], labels, options: Optional[str] = No
     block = mini_batch if mode == "minibatch" else cl.get_int("block_size", 4096)
     row_chunk = cl.get_int("row_chunk", 0) or None
     if row_chunk is not None:
+        if row_chunk <= 0:
+            raise ValueError(f"-row_chunk must be positive, got {row_chunk}")
         if mode != "minibatch":
             raise ValueError("-row_chunk requires -mini_batch > 1 "
                              "(it tiles the minibatch pairwise work)")

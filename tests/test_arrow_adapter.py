@@ -1,0 +1,97 @@
+"""Arrow engine bridge (adapters/arrow.py) — train from an Arrow table,
+emit/ingest model tables, IPC round trip as the -loadmodel analog, and
+streaming predict over record batches."""
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from hivemall_tpu.adapters import (arrow_ops, model_from_arrow,
+                                   model_to_arrow, predict_batches,
+                                   read_model_ipc, write_model_ipc)
+
+
+def _make_table(n=600, d=64, seed=0):
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(d)
+    feats, labels = [], []
+    for _ in range(n):
+        f = rng.choice(d, size=6, replace=False)
+        v = rng.rand(6).round(3)
+        feats.append([f"{i}:{x}" for i, x in zip(f, v)])
+        labels.append(float(np.sign(np.dot(w_true[f], v))) or 1.0)
+    return pa.table({"features": feats, "label": labels}), w_true
+
+
+def test_train_from_arrow_table():
+    table, _ = _make_table()
+    model = arrow_ops(table).train_arow("features", "label", "-dims 64")
+    feats = table.column("features").to_pylist()
+    y = np.asarray(table.column("label").to_numpy())
+    acc = float(np.mean(np.sign(model.predict(feats)) == y))
+    assert acc > 0.9, acc
+
+
+def test_model_arrow_round_trip(tmp_path):
+    table, _ = _make_table(seed=1)
+    model = arrow_ops(table).train_arow("features", "label", "-dims 64")
+
+    t = model_to_arrow(model)
+    assert t.column_names == ["feature", "weight", "covar"]  # AROW has covar
+    assert t.num_rows > 0
+
+    w, cov = model_from_arrow(t, dims=64)
+    state_w = np.asarray(model.state.weights)
+    np.testing.assert_allclose(w, np.where(
+        np.asarray(model.state.touched) != 0, state_w, 0.0), rtol=1e-6)
+    assert cov is not None
+
+    path = str(tmp_path / "model.arrow")
+    write_model_ipc(model, path)
+    w2, cov2 = read_model_ipc(path, dims=64)
+    np.testing.assert_array_equal(w2, w)
+    np.testing.assert_array_equal(cov2, cov)
+
+
+def test_warm_start_from_arrow_model(tmp_path):
+    """The -loadmodel analog: a model table read back from IPC seeds a new
+    trainer (LearnerBaseUDTF.java:215-333)."""
+    from hivemall_tpu.core.engine import make_train_step
+    from hivemall_tpu.core.state import init_linear_state
+    from hivemall_tpu.models.classifier import AROW
+
+    table, _ = _make_table(seed=2)
+    model = arrow_ops(table).train_arow("features", "label", "-dims 64")
+    path = str(tmp_path / "m.arrow")
+    write_model_ipc(model, path)
+    w, cov = read_model_ipc(path, dims=64)
+
+    state = init_linear_state(64, use_covariance=True, initial_weights=w,
+                              initial_covars=cov)
+    step = make_train_step(AROW, {"r": 0.1}, donate=False)
+    idx = np.array([[1, 2, 3, 0, 0, 0]], np.int32)
+    val = np.array([[1.0, 0.5, 0.2, 0, 0, 0]], np.float32)
+    out, loss = step(state, idx, val, np.array([1.0], np.float32))
+    assert np.isfinite(float(loss))
+
+
+def test_streaming_predict_over_batches():
+    table, _ = _make_table(seed=3)
+    model = arrow_ops(table).train_arow("features", "label", "-dims 64")
+    batches = table.to_batches(max_chunksize=128)
+    outs = list(predict_batches(model, batches))
+    assert sum(len(o) for o in outs) == table.num_rows
+    whole = np.asarray(model.predict(table.column("features").to_pylist()))
+    np.testing.assert_allclose(np.concatenate(outs), whole, rtol=1e-5)
+
+
+def test_registry_trainers_reachable():
+    table, _ = _make_table(seed=4)
+    ops = arrow_ops(table)
+    m1 = ops.train_perceptron("features", "label", "-dims 64")
+    m2 = ops.train_scw("features", "label", "-dims 64")
+    assert m1.state.weights.shape == (64,)
+    assert m2.state.covars is not None
+    with pytest.raises(AttributeError):
+        ops.not_a_trainer
