@@ -53,8 +53,6 @@ def table_features(table, features_col: str):
     pa = _require_pyarrow()
     col = table.column(features_col) if hasattr(table, "column") \
         else table[features_col]
-    if isinstance(col, pa.ChunkedArray):
-        col = col.combine_chunks()
     return col.to_pylist()
 
 
@@ -78,7 +76,10 @@ class ArrowOps:
 
     def __getattr__(self, name: str):
         if name.startswith("train_"):
-            fn = get_function(name)
+            try:
+                fn = get_function(name)
+            except KeyError:
+                raise AttributeError(name) from None
 
             def trainer(features_col: str, label_col: str,
                         options: Optional[str] = None):
@@ -117,16 +118,20 @@ def model_to_arrow(model):
 def model_from_arrow(table, dims: int):
     """Warm-start arrays from a model table: returns (initial_weights,
     initial_covars-or-None) for init_linear_state / the trainers'
-    `-loadmodel` path."""
+    `-loadmodel` path. Errors on a dims mismatch rather than silently
+    aliasing features into a smaller table."""
     feats = np.asarray(table.column("feature").to_numpy(zero_copy_only=False),
                        dtype=np.int64)
+    if feats.size and int(feats.max()) >= dims:
+        raise ValueError(
+            f"model table has feature id {int(feats.max())} >= dims {dims}; "
+            "load it with the dims it was trained at")
     w = np.zeros(dims, np.float32)
-    w[feats % dims] = table.column("weight").to_numpy(zero_copy_only=False)
+    w[feats] = table.column("weight").to_numpy(zero_copy_only=False)
     cov = None
     if "covar" in table.column_names:
         cov = np.ones(dims, np.float32)
-        cov[feats % dims] = table.column("covar").to_numpy(
-            zero_copy_only=False)
+        cov[feats] = table.column("covar").to_numpy(zero_copy_only=False)
     return w, cov
 
 

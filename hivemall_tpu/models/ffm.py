@@ -409,8 +409,7 @@ def train_ffm(rows: Sequence[Sequence[str]], labels, options: Optional[str] = No
     block = mini_batch if mode == "minibatch" else cl.get_int("block_size", 4096)
     row_chunk = cl.get_int("row_chunk", 0) or None
     if row_chunk is not None:
-        if row_chunk <= 0:
-            raise ValueError(f"-row_chunk must be positive, got {row_chunk}")
+        # positivity is validated by make_ffm_step (single source)
         if mode != "minibatch":
             raise ValueError("-row_chunk requires -mini_batch > 1 "
                              "(it tiles the minibatch pairwise work)")

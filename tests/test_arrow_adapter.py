@@ -69,6 +69,9 @@ def test_warm_start_from_arrow_model(tmp_path):
 
     state = init_linear_state(64, use_covariance=True, initial_weights=w,
                               initial_covars=cov)
+    # the warm start actually took: the seeded state IS the loaded model
+    np.testing.assert_allclose(np.asarray(state.weights), w, rtol=1e-7)
+    np.testing.assert_allclose(np.asarray(state.covars), cov, rtol=1e-7)
     step = make_train_step(AROW, {"r": 0.1}, donate=False)
     idx = np.array([[1, 2, 3, 0, 0, 0]], np.int32)
     val = np.array([[1.0, 0.5, 0.2, 0, 0, 0]], np.float32)
