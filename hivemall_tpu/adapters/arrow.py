@@ -122,9 +122,10 @@ def model_from_arrow(table, dims: int):
     aliasing features into a smaller table."""
     feats = np.asarray(table.column("feature").to_numpy(zero_copy_only=False),
                        dtype=np.int64)
-    if feats.size and int(feats.max()) >= dims:
+    if feats.size and (int(feats.max()) >= dims or int(feats.min()) < 0):
         raise ValueError(
-            f"model table has feature id {int(feats.max())} >= dims {dims}; "
+            f"model table has feature ids outside [0, {dims}) "
+            f"(min {int(feats.min())}, max {int(feats.max())}); "
             "load it with the dims it was trained at")
     w = np.zeros(dims, np.float32)
     w[feats] = table.column("weight").to_numpy(zero_copy_only=False)

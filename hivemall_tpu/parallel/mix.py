@@ -250,6 +250,7 @@ class MixTrainer:
             reduction = "argmin_kld" if rule.use_covariance else "average"
         self.reduction = reduction
         self.n_dev = self.mesh.devices.size
+        self._step_base = 0  # set by init(from_state=...) on warm restart
         axis = config.axis_name
 
         local_fn = make_train_fn(rule, hyper, mode=mode, track_deltas=True)
@@ -293,10 +294,43 @@ class MixTrainer:
             global_names=self.rule.global_names,
         )
 
-    def init(self) -> LinearState:
+    def init(self, from_state: Optional[LinearState] = None) -> LinearState:
         """Replicated initial state with a leading device axis, sharded over
-        the mesh."""
-        return replicate_state(self._init_one(), self.n_dev, self.mesh,
+        the mesh. `from_state` seeds every replica from a collapsed
+        single-model state (a final_state() result or an
+        io/checkpoint.load_linear_state) — the elastic-restart path: resume
+        the same model on whatever mesh size survives. Missing optimizer
+        slots (e.g. the mix delta counter) fill with zeros; each replica
+        resumes at the checkpoint's step so eta schedules continue.
+        collapse_host()/final_state() subtract the seeded base from the
+        summed per-replica counters so the example count stays correct
+        across arbitrarily many checkpoint/resume cycles."""
+        one = self._init_one()
+        self._step_base = 0
+        if from_state is not None:
+            host = jax.device_get(from_state)
+            if np.asarray(host.weights).shape[0] != self.dims:
+                raise ValueError(
+                    f"checkpoint has dims {np.asarray(host.weights).shape[0]}"
+                    f" != trainer dims {self.dims}; resume with the dims the"
+                    " model was trained at")
+            self._step_base = int(np.asarray(host.step))
+            have = dict(host.slots) if host.slots else {}
+            one = one.replace(
+                weights=jnp.asarray(host.weights),
+                covars=(jnp.asarray(host.covars)
+                        if one.covars is not None and host.covars is not None
+                        else one.covars),
+                slots={name: (jnp.asarray(have[name]) if name in have
+                              else zero)
+                       for name, zero in one.slots.items()},
+                touched=jnp.asarray(host.touched),
+                step=jnp.asarray(host.step),
+                globals={name: (jnp.asarray(np.asarray(host.globals[name]))
+                                if name in (host.globals or {}) else zero)
+                         for name, zero in one.globals.items()},
+            )
+        return replicate_state(one, self.n_dev, self.mesh,
                                axis=self.config.axis_name)
 
     def step(self, state: LinearState, indices, values, labels):
@@ -309,8 +343,22 @@ class MixTrainer:
         [n_dev, k, B, ...] layout."""
         return split_replica_blocks(self.n_dev, indices, values, labels)
 
+    def collapse_host(self, host: LinearState) -> LinearState:
+        """Collapse a host-side replicated state (see
+        collapse_linear_replicas), correcting the step counter: every
+        replica of a warm-started run was seeded with the checkpoint's step,
+        so the per-replica sum counts that base n_dev times — subtract the
+        (n_dev - 1) extra copies to keep `step` = total examples ever
+        trained, across any number of resume cycles."""
+        merged = collapse_linear_replicas(host, dict(self.rule.slot_merge))
+        base = getattr(self, "_step_base", 0)
+        if base:
+            merged = merged.replace(
+                step=(merged.step - (self.n_dev - 1) * base).astype(
+                    np.asarray(merged.step).dtype))
+        return merged
+
     def final_state(self, state: LinearState) -> LinearState:
         """Collapse the device axis after the trailing mix into one model a
-        warm restart can resume from — see collapse_linear_replicas."""
-        return collapse_linear_replicas(jax.device_get(state),
-                                        dict(self.rule.slot_merge))
+        warm restart can resume from — see collapse_host."""
+        return self.collapse_host(jax.device_get(state))

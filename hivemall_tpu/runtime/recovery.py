@@ -1,0 +1,75 @@
+"""Failure detection + elastic restart — the fault-tolerance story.
+
+The reference's fault tolerance is thin by design (SURVEY.md §5): MIX
+clients reconnect dead channels on the next send (MixClient.java:134-137),
+server sessions expire by TTL, cancel messages retract a failed task's
+contributions (AbstractPredictionModel.java:88-118), and everything else is
+delegated to Hadoop task retry — a failed mapper is simply rerun and the
+surviving tasks' model rows are what the final ensemble averages.
+
+Under synchronous SPMD the failure unit is the JOB, not a task: a dead
+process breaks the collectives, the step errors, and recovery is
+restart-from-checkpoint on whatever topology survives. That is strictly
+stronger than the reference's story (which loses the failed mapper's entire
+contribution since its close() never runs): here the periodic checkpoint of
+the MIXED model preserves every replica's averaged-in work up to the last
+mix. The cancel machinery is unnecessary — a checkpoint never contains a
+partial, retractable contribution.
+
+Usage (the driver loop):
+
+    trainer, state = elastic_resume(AROW, {"r": 0.1}, dims, "ckpt.npz")
+    while blocks:
+        state, loss = trainer.step(state, *next_blocks)
+        if step % k == 0:
+            checkpoint(trainer, state, "ckpt.npz")
+
+On any distributed failure: relaunch the job on the surviving hosts; the
+same elastic_resume call rebuilds the trainer over the NEW (smaller or
+larger) mesh and reseeds every replica from the checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from ..core.engine import Rule
+from ..io.checkpoint import load_linear_state, save_linear_state
+from ..parallel.mix import MixConfig, MixTrainer
+
+
+def checkpoint(trainer: MixTrainer, state, path: str) -> None:
+    """Atomically persist the COLLAPSED (mixed, replica-free) model — the
+    form any future mesh size can resume from. Write-then-rename so a crash
+    mid-write never corrupts the previous checkpoint.
+
+    Under multi-process jax this is a COLLECTIVE: every process must call it
+    (the global state is not addressable from one process; an allgather
+    brings it to every host), and only process 0 writes the file."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        host = multihost_utils.process_allgather(state, tiled=True)
+        merged = trainer.collapse_host(host)
+        if jax.process_index() != 0:
+            return
+    else:
+        merged = trainer.final_state(state)
+    # .npz suffix keeps np.savez from renaming the temp file under us
+    tmp = path + ".tmp.npz"
+    save_linear_state(tmp, merged)
+    os.replace(tmp, path)
+
+
+def elastic_resume(rule: Rule, hyper: dict, dims: int, path: str,
+                   mesh=None, config: MixConfig = MixConfig(),
+                   mode: str = "minibatch") -> Tuple[MixTrainer, object]:
+    """Build a MixTrainer over the CURRENT mesh (whatever jax.devices() — or
+    the passed mesh — says survives) and seed it from the checkpoint at
+    `path` if one exists, else from zeros. Returns (trainer, state)."""
+    trainer = MixTrainer(rule, hyper, dims, mesh, config, mode=mode)
+    from_state = load_linear_state(path) if os.path.exists(path) else None
+    return trainer, trainer.init(from_state=from_state)

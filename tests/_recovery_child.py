@@ -1,0 +1,55 @@
+"""Child body for the elastic-recovery multi-process test (spawned by
+tests/test_elastic_recovery.py): a 2-process distributed job that trains,
+checkpoints the mixed model, then ABORTS (both processes exit non-zero) —
+simulating the job-level failure synchronous SPMD turns any process death
+into. The parent is the Hadoop-retry analog: it detects the failure and
+elastically resumes on the surviving topology."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    out_dir = sys.argv[1]
+
+    from hivemall_tpu.runtime.cluster import init_cluster
+
+    assert init_cluster()
+
+    import jax
+
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.parallel import MixConfig, MixTrainer, make_mesh
+    from hivemall_tpu.runtime.recovery import checkpoint
+
+    dims, n_dev, k, B, K = 128, 4, 2, 16, 8
+    trainer = MixTrainer(AROW, {"r": 0.1}, dims, make_mesh(),
+                         MixConfig(mix_every=2))
+    state = trainer.init()
+    rng = np.random.RandomState(21)  # same stream on both processes
+    w_true = rng.randn(dims)
+    for phase in range(2):
+        idx = rng.randint(0, dims, size=(n_dev, k, B, K)).astype(np.int32)
+        val = rng.rand(n_dev, k, B, K).astype(np.float32)
+        lab = np.sign(np.sum(w_true[idx] * val, axis=-1)).astype(np.float32)
+        state, loss = trainer.step(state, idx, val, lab)
+
+    ckpt = os.path.join(out_dir, "ckpt.npz")
+    # collective: every process calls it; process 0 writes the file
+    checkpoint(trainer, state, ckpt)
+    # both processes observe the checkpoint then abort: the job-level
+    # failure (a real process death would break the next collective; the
+    # driver's recovery path is identical either way)
+    import jax.experimental.multihost_utils as mh
+
+    mh.sync_global_devices("checkpointed")
+    print(f"CHILD {jax.process_index()} CHECKPOINTED", flush=True)
+    os._exit(7)
+
+
+if __name__ == "__main__":
+    main()
