@@ -103,11 +103,37 @@ class LatticeTokenizer:
             out.extend(self._viterbi(seg))
         return out
 
-    def _candidates(self, s: str, i: int) -> List[Tuple[str, str, int]]:
-        """(surface, pos, word_cost) candidates starting at position i."""
+    def decompound(self, token: str) -> List[str]:
+        """SEARCH-mode splitting of a long compound (>= 4 chars): re-run the
+        lattice over the token with whole-token candidates suppressed, so
+        the best dictionary-backed split wins (機械学習 -> 機械/学習) and
+        unknown compounds fall to their 2-char unknown pieces — the analog
+        of Kuromoji SEARCH mode's long-kanji-node penalty. Returns [] when
+        the token should stay whole (shorter than 4, or no split parses)."""
+        if len(token) < 4:
+            return []
+        parts = [s for s, _ in self._viterbi(token, suppress_whole=True)]
+        # only trust DICTIONARY-BACKED splits: at least half the characters
+        # must sit in lexicon entries of length >= 2, else (all-unknown
+        # compound) the lattice split is arbitrary — Kuromoji likewise only
+        # decompounds via dictionary entries; the caller falls back to
+        # recall-oriented 2-grams
+        covered = sum(len(s) for s in parts
+                      if len(s) >= 2 and s in self.lexicon)
+        if len(parts) > 1 and 2 * covered >= len(token):
+            return parts
+        return []
+
+    def _candidates(self, s: str, i: int,
+                    suppress_whole: bool = False) -> List[Tuple[str, str, int]]:
+        """(surface, pos, word_cost) candidates starting at position i.
+        `suppress_whole` drops any candidate spanning all of `s` (the
+        decompound path must produce >= 2 parts)."""
         cands: List[Tuple[str, str, int]] = []
         # dictionary hits
         for L in range(1, min(self.max_word, len(s) - i) + 1):
+            if suppress_whole and i == 0 and L == len(s):
+                continue
             surf = s[i : i + L]
             for pos, cost in self.lexicon.get(surf, ()):
                 cands.append((surf, pos, cost))
@@ -127,13 +153,16 @@ class LatticeTokenizer:
         else:  # hira
             lengths = list(range(1, min(run, 3) + 1))
         for L in lengths:
+            if suppress_whole and i == 0 and L == len(s):
+                continue
             surf = s[i : i + L]
             if any(c[0] == surf for c in cands):
                 continue  # lexicon entry already covers this surface
             cands.append((surf, pos, base + per * L))
         return cands
 
-    def _viterbi(self, s: str) -> List[Tuple[str, str]]:
+    def _viterbi(self, s: str,
+                 suppress_whole: bool = False) -> List[Tuple[str, str]]:
         n = len(s)
         INF = 1 << 60
         # best[i] = (cost, prev_index, surface, pos) reaching position i
@@ -143,7 +172,7 @@ class LatticeTokenizer:
             cost_i, _, _, pos_i = best[i]
             if cost_i >= INF:
                 continue
-            for surf, pos, wcost in self._candidates(s, i):
+            for surf, pos, wcost in self._candidates(s, i, suppress_whole):
                 j = i + len(surf)
                 conn = 0 if pos_i == _BOS else _CONN.get((pos_i, pos), 0)
                 total = cost_i + wcost + conn

@@ -68,7 +68,9 @@ _NOUNS = [
     "頭", "体", "心", "気", "声", "色", "形", "数", "前", "後", "上",
     "下", "中", "外", "間", "こと", "もの", "ところ", "とき", "ため",
     "ほう", "方", "的", "さん", "君", "様", "機械", "学習", "計算",
-    "情報", "技術",
+    "情報", "技術", "言語", "処理", "自然", "国際", "空港", "科学",
+    "関西", "関東", "経済", "政治", "社会", "文化", "歴史", "教育",
+    "環境", "開発", "分析", "予測", "回帰", "分類", "学会", "論文",
 ]
 
 _MISC_VERBS = [  # polite/formulaic chunks, IPADic-style single units

@@ -120,12 +120,19 @@ def tokenize_ja(text: str, mode: str = "normal",
                 continue
             tokens.append(tok.surface)
     if mode in ("search", "extended"):
-        # SEARCH mode additionally decompounds long tokens; the fallback
-        # approximates by also emitting 2-grams of long kanji runs
+        # SEARCH mode additionally decompounds long tokens (Kuromoji keeps
+        # the compound AND emits its parts). The lattice backend re-segments
+        # the compound with whole-token candidates suppressed (dictionary-
+        # backed split); other backends fall back to kanji 2-grams.
         extra: List[str] = []
         for t in tokens:
             if len(t) >= 4 and all(_char_class(c) == "kanji" for c in t):
-                extra.extend(t[i : i + 2] for i in range(len(t) - 1))
+                parts: List[str] = []
+                if _BACKEND_NAME == "lattice":
+                    parts = backend.decompound(t)
+                if not parts:
+                    parts = [t[i : i + 2] for i in range(len(t) - 1)]
+                extra.extend(parts)
         tokens = tokens + extra
     if stopwords:
         stop = set(stopwords)

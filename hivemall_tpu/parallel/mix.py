@@ -169,6 +169,93 @@ def make_linear_mix(reduction: str, axis: str):
     return mix
 
 
+def _welford_sub(nc, mc, m2c, n0, mu0, m20):
+    """Chan-inverse: remove the base stream (n0, mu0, m20) from a combined
+    (nc, mc, m2c), returning the local remainder — exact."""
+    n_l = nc - n0
+    if n_l <= 0:
+        return 0.0, 0.0, 0.0
+    mean_l = (mc * nc - mu0 * n0) / n_l
+    m2_l = m2c - m20 - (n0 * n_l / nc) * (mean_l - mu0) ** 2
+    return n_l, mean_l, max(m2_l, 0.0)
+
+
+def _welford_add(n_a, mu_a, m2_a, n_b, mu_b, m2_b):
+    """Chan parallel merge of two streams — exact."""
+    n = n_a + n_b
+    if n == 0:
+        return 0.0, 0.0, 0.0
+    delta = mu_b - mu_a
+    mean = mu_a + delta * n_b / n
+    m2 = m2_a + m2_b + delta * delta * n_a * n_b / n
+    return n, mean, m2
+
+
+def strip_replica_base(host: LinearState, base: LinearState,
+                       slot_kinds: dict) -> LinearState:
+    """Remove a warm-start base (the checkpoint every replica was seeded
+    with) from each replica's ADDITIVE statistics, leaving only the local
+    contributions, so a subsequent collapse_linear_replicas does not count
+    the base once per replica: "sum"-kind slots and the step counter
+    subtract the base per replica; Welford globals chan-subtract it. Mean
+    -kind (EMA) slots stay — averaging seeded EMAs is their semantics.
+    add_replica_base() restores the base once after the collapse."""
+    b = jax.device_get(base)
+    new_slots = dict(host.slots or {})
+    for name, kind in slot_kinds.items():
+        if kind == "sum" and name in new_slots and name in (b.slots or {}):
+            new_slots[name] = np.asarray(new_slots[name]) \
+                - np.asarray(b.slots[name])[None]
+    gl = dict(host.globals or {})
+    if {"n", "mean", "m2"} <= set(gl) and {"n", "mean", "m2"} <= set(
+            b.globals or {}):
+        n0 = float(np.asarray(b.globals["n"]))
+        mu0 = float(np.asarray(b.globals["mean"]))
+        m20 = float(np.asarray(b.globals["m2"]))
+        ns, mus, m2s = [], [], []
+        for r in range(np.asarray(gl["n"]).shape[0]):
+            n_l, mu_l, m2_l = _welford_sub(
+                float(np.asarray(gl["n"])[r]), float(np.asarray(gl["mean"])[r]),
+                float(np.asarray(gl["m2"])[r]), n0, mu0, m20)
+            ns.append(n_l)
+            mus.append(mu_l)
+            m2s.append(m2_l)
+        gl = {**gl, "n": np.asarray(ns, np.float32),
+              "mean": np.asarray(mus, np.float32),
+              "m2": np.asarray(m2s, np.float32)}
+    return host.replace(
+        slots=new_slots,
+        globals=gl,
+        step=np.asarray(host.step) - int(np.asarray(b.step)),
+    )
+
+
+def add_replica_base(merged: LinearState, base: LinearState,
+                     slot_kinds: dict) -> LinearState:
+    """Restore the warm-start base ONCE into a collapsed model (see
+    strip_replica_base)."""
+    b = jax.device_get(base)
+    new_slots = dict(merged.slots or {})
+    for name, kind in slot_kinds.items():
+        if kind == "sum" and name in new_slots and name in (b.slots or {}):
+            new_slots[name] = np.asarray(new_slots[name]) \
+                + np.asarray(b.slots[name])
+    gl = dict(merged.globals or {})
+    if {"n", "mean", "m2"} <= set(gl) and {"n", "mean", "m2"} <= set(
+            b.globals or {}):
+        n, mu, m2 = _welford_add(
+            float(np.asarray(gl["n"])), float(np.asarray(gl["mean"])),
+            float(np.asarray(gl["m2"])),
+            float(np.asarray(b.globals["n"])),
+            float(np.asarray(b.globals["mean"])),
+            float(np.asarray(b.globals["m2"])))
+        gl = {**gl, "n": np.float32(n), "mean": np.float32(mu),
+              "m2": np.float32(m2)}
+    step = np.asarray(merged.step) + int(np.asarray(b.step))
+    return merged.replace(slots=new_slots, globals=gl,
+                          step=step.astype(np.asarray(merged.step).dtype))
+
+
 def collapse_linear_replicas(host: LinearState, slot_kinds: dict) -> LinearState:
     """Collapse a host-side LinearState whose leaves carry a leading replica
     axis into one model a warm restart can resume from (the mixed analog of
@@ -250,7 +337,7 @@ class MixTrainer:
             reduction = "argmin_kld" if rule.use_covariance else "average"
         self.reduction = reduction
         self.n_dev = self.mesh.devices.size
-        self._step_base = 0  # set by init(from_state=...) on warm restart
+        self._resume_base = None  # set by init(from_state=...) on warm restart
         axis = config.axis_name
 
         local_fn = make_train_fn(rule, hyper, mode=mode, track_deltas=True)
@@ -301,12 +388,14 @@ class MixTrainer:
         io/checkpoint.load_linear_state) — the elastic-restart path: resume
         the same model on whatever mesh size survives. Missing optimizer
         slots (e.g. the mix delta counter) fill with zeros; each replica
-        resumes at the checkpoint's step so eta schedules continue.
-        collapse_host()/final_state() subtract the seeded base from the
-        summed per-replica counters so the example count stays correct
-        across arbitrarily many checkpoint/resume cycles."""
+        resumes at the checkpoint's step/curvature so eta schedules
+        continue. collapse_host()/final_state() strip the seeded base from
+        each replica's ADDITIVE statistics (step counter, sum-kind slots,
+        Welford globals) before merging and restore it once after, so
+        nothing is counted n_dev times no matter how many checkpoint/resume
+        cycles stack (strip_replica_base/add_replica_base)."""
         one = self._init_one()
-        self._step_base = 0
+        self._resume_base = None
         if from_state is not None:
             host = jax.device_get(from_state)
             if np.asarray(host.weights).shape[0] != self.dims:
@@ -314,7 +403,7 @@ class MixTrainer:
                     f"checkpoint has dims {np.asarray(host.weights).shape[0]}"
                     f" != trainer dims {self.dims}; resume with the dims the"
                     " model was trained at")
-            self._step_base = int(np.asarray(host.step))
+            self._resume_base = host
             have = dict(host.slots) if host.slots else {}
             one = one.replace(
                 weights=jnp.asarray(host.weights),
@@ -345,17 +434,18 @@ class MixTrainer:
 
     def collapse_host(self, host: LinearState) -> LinearState:
         """Collapse a host-side replicated state (see
-        collapse_linear_replicas), correcting the step counter: every
-        replica of a warm-started run was seeded with the checkpoint's step,
-        so the per-replica sum counts that base n_dev times — subtract the
-        (n_dev - 1) extra copies to keep `step` = total examples ever
-        trained, across any number of resume cycles."""
-        merged = collapse_linear_replicas(host, dict(self.rule.slot_merge))
-        base = getattr(self, "_step_base", 0)
-        if base:
-            merged = merged.replace(
-                step=(merged.step - (self.n_dev - 1) * base).astype(
-                    np.asarray(merged.step).dtype))
+        collapse_linear_replicas). For a warm-started run, every replica was
+        seeded with the checkpoint's additive statistics (step, sum-kind
+        slots, Welford globals); strip that base per replica before merging
+        and restore it once after, so each statistic equals
+        base + sum(local contributions) exactly."""
+        kinds = dict(self.rule.slot_merge)
+        base = getattr(self, "_resume_base", None)
+        if base is not None:
+            host = strip_replica_base(host, base, kinds)
+        merged = collapse_linear_replicas(host, kinds)
+        if base is not None:
+            merged = add_replica_base(merged, base, kinds)
         return merged
 
     def final_state(self, state: LinearState) -> LinearState:

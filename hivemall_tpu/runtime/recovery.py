@@ -53,11 +53,17 @@ def checkpoint(trainer: MixTrainer, state, path: str) -> None:
         from jax.experimental import multihost_utils
 
         host = multihost_utils.process_allgather(state, tiled=True)
-        merged = trainer.collapse_host(host)
-        if jax.process_index() != 0:
-            return
-    else:
-        merged = trainer.final_state(state)
+        if jax.process_index() == 0:
+            merged = trainer.collapse_host(host)
+            tmp = path + ".tmp.npz"
+            save_linear_state(tmp, merged)
+            os.replace(tmp, path)
+        # trailing barrier: no process may act on "checkpoint written"
+        # (e.g. tear the job down for an elastic downscale) until the
+        # write+rename actually completed on process 0
+        multihost_utils.sync_global_devices("hivemall_tpu_checkpoint")
+        return
+    merged = trainer.final_state(state)
     # .npz suffix keeps np.savez from renaming the temp file under us
     tmp = path + ".tmp.npz"
     save_linear_state(tmp, merged)

@@ -84,6 +84,66 @@ def test_elastic_resume_smaller_mesh(tmp_path):
     assert int(final4.step) == 8 * 8 * 16 + 4 * 8 * 16, int(final4.step)
 
 
+def test_resume_preserves_additive_statistics(tmp_path):
+    """Sum-kind optimizer slots (AdaGrad curvature), the step counter, and
+    Welford globals must NOT be multiplied by the replica count across a
+    checkpoint/resume cycle: resuming and immediately collapsing is the
+    identity, and new work adds on top exactly once."""
+    import jax
+
+    from hivemall_tpu.models.regression import ADAGRAD_REGR, PA1A_REGR
+    from hivemall_tpu.parallel import MixConfig, make_mesh
+    from hivemall_tpu.runtime.recovery import checkpoint, elastic_resume
+
+    dims = 128
+
+    def reg_blocks(n_dev, k, seed):
+        rng = np.random.RandomState(seed)
+        idx = rng.randint(0, dims, size=(n_dev, k, 16, 8)).astype(np.int32)
+        val = rng.rand(n_dev, k, 16, 8).astype(np.float32)
+        lab = rng.rand(n_dev, k, 16).astype(np.float32)
+        return idx, val, lab
+
+    for rule, hyper, check in (
+        (ADAGRAD_REGR, {"eta": 1.0, "eps": 1.0, "scale": 100.0}, "slot"),
+        (PA1A_REGR, {"c": 1.0, "epsilon": 0.1}, "welford"),
+    ):
+        ck = str(tmp_path / f"{rule.name}.npz")
+        t4, s4 = elastic_resume(rule, hyper, dims, ck, mesh=make_mesh(4),
+                                config=MixConfig(mix_every=2))
+        s4, _ = t4.step(s4, *reg_blocks(4, 2, 1))
+        checkpoint(t4, s4, ck)
+        base = t4.final_state(s4)
+
+        # resume on MORE replicas; immediate collapse == the checkpoint
+        t8, s8 = elastic_resume(rule, hyper, dims, ck, mesh=make_mesh(8),
+                                config=MixConfig(mix_every=2))
+        again = t8.final_state(s8)
+        assert int(again.step) == int(base.step) == 4 * 2 * 16
+        if check == "slot":
+            np.testing.assert_allclose(
+                np.asarray(again.slots["sum_sqgrad"]),
+                np.asarray(base.slots["sum_sqgrad"]), rtol=1e-6, atol=1e-7)
+        else:
+            assert float(again.globals["n"]) == pytest.approx(
+                float(base.globals["n"]))
+            assert float(again.globals["mean"]) == pytest.approx(
+                float(base.globals["mean"]), rel=1e-5)
+            assert float(again.globals["m2"]) == pytest.approx(
+                float(base.globals["m2"]), rel=1e-4)
+
+        # and new work adds exactly once
+        s8, _ = t8.step(s8, *reg_blocks(8, 2, 2))
+        final = t8.final_state(s8)
+        assert int(final.step) == int(base.step) + 8 * 2 * 16
+        if check == "slot":
+            assert np.all(np.asarray(final.slots["sum_sqgrad"])
+                          >= np.asarray(base.slots["sum_sqgrad"]) - 1e-7)
+        else:
+            assert float(final.globals["n"]) == pytest.approx(
+                float(base.globals["n"]) + 8 * 2 * 16)
+
+
 def test_multiprocess_failure_then_elastic_restart(tmp_path):
     """The Hadoop-retry analog end-to-end: a 2-process job checkpoints its
     mixed model and aborts (rc=7); the driver detects the failure and

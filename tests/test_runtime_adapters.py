@@ -89,6 +89,20 @@ class TestNlp:
         assert toks == ["彼女", "は", "新しい", "本", "を", "読み", "まし",
                         "た"], toks
 
+    def test_tokenize_ja_search_mode_dictionary_decompound(self):
+        """SEARCH mode emits a long compound's dictionary-backed parts
+        (Kuromoji search-mode analog); all-unknown compounds fall back to
+        recall-oriented 2-grams rather than an arbitrary lattice split."""
+        from hivemall_tpu.nlp.lattice import LatticeTokenizer
+
+        t = LatticeTokenizer()
+        assert t.decompound("関西国際空港") == ["関西", "国際", "空港"]
+        # all-unknown compound: no dictionary backing -> no lattice split
+        assert t.decompound("特許許可局") == []
+        # SEARCH keeps the 2-gram fallback for those
+        toks = tokenize_ja("東京特許許可局", "search")
+        assert "特許" in toks and "許可" in toks
+
     def test_tokenize_ja_stoptags_filter_pos(self):
         """POS stoptags drop particles/auxiliaries (the classic Kuromoji
         stoptag use), keeping content morphemes."""
