@@ -101,6 +101,30 @@ def _row_predict(w0, wg, vg, val):
     return p, sum_vfx
 
 
+def sharded_gather_predict(w, v, w0, idx, val, shard_axis: str, stripe: int):
+    """The ONE copy of the feature-sharded FM gather + prediction, used by
+    both the sharded train step and the sharded serving path (so train-time
+    and serve-time p can never drift): translate global ids into the local
+    [stripe] tables (foreign/pad lanes -> the drop slot, value masked to 0),
+    gather owned lanes, and combine the three prediction partials with a
+    single fused psum over the stripe axis. Works on any leading batch
+    shape; idx/val are [..., K]."""
+    dev = jax.lax.axis_index(shard_axis)
+    lidx = idx - dev * stripe
+    owned = (lidx >= 0) & (lidx < stripe)
+    lidx = jnp.where(owned, lidx, stripe)
+    vmask = val * owned.astype(val.dtype)
+    wg = w.at[lidx].get(mode="fill", fill_value=0.0)
+    vg = v.at[lidx].get(mode="fill", fill_value=0.0)
+    vx = vg * vmask[..., None]
+    linear, sum_vfx, sum_v2x2 = jax.lax.psum(
+        (jnp.sum(wg * vmask, axis=-1),
+         jnp.sum(vx, axis=-2),
+         jnp.sum(vx * vx, axis=-2)), shard_axis)
+    p = w0 + linear + 0.5 * jnp.sum(sum_vfx * sum_vfx - sum_v2x2, axis=-1)
+    return wg, vg, vmask, lidx, p, sum_vfx
+
+
 def _dloss_and_loss(p, y, hyper: FMHyper):
     if hyper.classification:
         # dloss = (sigmoid(p*y) - 1)*y; loss = log(1 + exp(-p*y))
@@ -115,7 +139,8 @@ def _dloss_and_loss(p, y, hyper: FMHyper):
 
 
 def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
-                 mini_batch_average: bool = True):
+                 mini_batch_average: bool = True,
+                 feature_shard: Optional[Tuple[str, int]] = None):
     """Jitted FM block update. scan = reference-exact sequential; minibatch =
     accumulate-then-apply against block-start parameters.
 
@@ -127,20 +152,43 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
     bridge semantic, same as core/engine.py's minibatch mode). Without it the
     raw sums scale the effective step by the per-feature row frequency and
     diverge at CTR batch sizes/head features.
-    """
+
+    `feature_shard=(axis_name, stripe)` runs the same step on a [D/stripe]
+    model stripe inside shard_map — the FM analog of the engine's
+    feature-sharded training (the V table is the framework's largest model
+    state: [2^24, k] does not fit one chip with optimizer state). Per row,
+    each device gathers its owned lanes, the three prediction partials
+    (linear term, sumVfX[k], sumV2X2[k]) psum over the stripe axis, and the
+    lane updates — functions of (global g, global sumVfX, lane-local w/V) —
+    scatter into the local stripe only. Exact up to psum order. adareg is
+    not supported sharded (its lambda updates need cross-stripe v' sums)."""
+    if feature_shard is not None and hyper.adareg:
+        raise ValueError("adareg is not supported with feature_shard")
+
+    if feature_shard is None:
+        def gather_and_predict(state: FMState, idx, val):
+            wg = state.w.at[idx].get(mode="fill", fill_value=0.0)
+            vg = state.v.at[idx].get(mode="fill", fill_value=0.0)
+            p, sum_vfx = _row_predict(state.w0, wg, vg, val)
+            return wg, vg, val, idx, p, sum_vfx
+    else:
+        shard_axis, stripe = feature_shard
+
+        def gather_and_predict(state: FMState, idx, val):
+            wg, vg, vmask, lidx, p, sum_vfx = sharded_gather_predict(
+                state.w, state.v, state.w0, idx, val, shard_axis, stripe)
+            return wg, vg, vmask, lidx, p, sum_vfx
 
     def row_deltas(state: FMState, idx, val, y, t):
         eta = hyper.eta.eta(t)
-        wg = state.w.at[idx].get(mode="fill", fill_value=0.0)
-        vg = state.v.at[idx].get(mode="fill", fill_value=0.0)
-        p, sum_vfx = _row_predict(state.w0, wg, vg, val)
+        wg, vg, eff_val, sidx, p, sum_vfx = gather_and_predict(state, idx, val)
         g, loss = _dloss_and_loss(p, y, hyper)
         dw0 = -eta * (g + 2.0 * state.lambda_w0 * state.w0)
-        dw = -eta * (g * val + 2.0 * state.lambda_w * wg)
-        x2 = val * val
-        grad_v = val[:, None] * sum_vfx[None, :] - vg * x2[:, None]
+        dw = -eta * (g * eff_val + 2.0 * state.lambda_w * wg)
+        x2 = eff_val * eff_val
+        grad_v = eff_val[:, None] * sum_vfx[None, :] - vg * x2[:, None]
         dv = -eta * (g * grad_v + 2.0 * state.lambda_v[None, :] * vg)
-        return dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta
+        return dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta, sidx
 
     def lambda_deltas(state: FMState, idx, val, y, t, wg, vg, g, sum_vfx, eta):
         # adaptive regularization (ref: FactorizationMachineModel.java:253-300)
@@ -159,14 +207,15 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
         def body(st: FMState, row):
             idx, val, y, is_va = row
             t = (st.step + 1).astype(jnp.float32)
-            dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta = row_deltas(st, idx, val, y, t)
+            dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta, sidx = \
+                row_deltas(st, idx, val, y, t)
             theta = 1.0 - is_va
             st2 = st.replace(
                 w0=st.w0 + theta * dw0,
-                w=st.w.at[idx].add(theta * dw, mode="drop"),
-                v=st.v.at[idx].add(theta * dv, mode="drop"),
-                touched=st.touched.at[idx].max(
-                    jnp.broadcast_to((theta > 0).astype(jnp.int8), idx.shape),
+                w=st.w.at[sidx].add(theta * dw, mode="drop"),
+                v=st.v.at[sidx].add(theta * dv, mode="drop"),
+                touched=st.touched.at[sidx].max(
+                    jnp.broadcast_to((theta > 0).astype(jnp.int8), sidx.shape),
                     mode="drop"),
                 step=st.step + 1,
             )
@@ -190,33 +239,33 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
         def per_row(idx, val, y, t):
             return row_deltas(state, idx, val, y, t)
 
-        dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta = jax.vmap(per_row)(
+        dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta, sidx = jax.vmap(per_row)(
             indices, values, labels, ts)
         theta = (1.0 - va_mask)  # [B]
         if mini_batch_average:
             # per-feature counts, then gather each lane's own denominator and
             # scatter the pre-divided deltas straight into the donated tables
             # — no full-[D] or full-[D,k] delta temporaries on the hot path
-            counts = jnp.zeros((state.w.shape[0],), jnp.float32).at[indices].add(
-                jnp.broadcast_to(theta[:, None], indices.shape), mode="drop")
+            counts = jnp.zeros((state.w.shape[0],), jnp.float32).at[sidx].add(
+                jnp.broadcast_to(theta[:, None], sidx.shape), mode="drop")
             denom_lanes = jnp.maximum(
-                counts.at[indices].get(mode="fill", fill_value=1.0), 1.0)
-            new_w = state.w.at[indices].add(
+                counts.at[sidx].get(mode="fill", fill_value=1.0), 1.0)
+            new_w = state.w.at[sidx].add(
                 theta[:, None] * dw / denom_lanes, mode="drop")
-            new_v = state.v.at[indices].add(
+            new_v = state.v.at[sidx].add(
                 theta[:, None, None] * dv / denom_lanes[:, :, None], mode="drop")
             new_w0 = state.w0 + jnp.sum(theta * dw0) / jnp.maximum(
                 jnp.sum(theta), 1.0)
         else:
-            new_w = state.w.at[indices].add(theta[:, None] * dw, mode="drop")
-            new_v = state.v.at[indices].add(theta[:, None, None] * dv, mode="drop")
+            new_w = state.w.at[sidx].add(theta[:, None] * dw, mode="drop")
+            new_v = state.v.at[sidx].add(theta[:, None, None] * dv, mode="drop")
             new_w0 = state.w0 + jnp.sum(theta * dw0)
         new_state = state.replace(
             w0=new_w0,
             w=new_w,
             v=new_v,
-            touched=state.touched.at[indices].max(
-                jnp.broadcast_to((theta > 0).astype(jnp.int8)[:, None], indices.shape),
+            touched=state.touched.at[sidx].max(
+                jnp.broadcast_to((theta > 0).astype(jnp.int8)[:, None], sidx.shape),
                 mode="drop"),
             step=state.step + b,
         )

@@ -129,14 +129,18 @@ class ShardedTrainer:
         init_linear_state (initial_weights/initial_covars = -loadmodel warm
         start, ref: LearnerBaseUDTF.java:215-333); [dims] arrays pad up to
         the sharded table size."""
+        shardings = jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec), self._specs)
+        if not kwargs:
+            # born sharded: no single-device materialization of the full
+            # tables (they exist sharded precisely because they don't fit)
+            return jax.jit(self._init_one, out_shardings=shardings)()
         for key, fill in (("initial_weights", 0.0), ("initial_covars", 1.0)):
             if kwargs.get(key) is not None:
                 kwargs[key] = _pad_initial(kwargs[key], self.dims_padded, fill)
         state = self._init_one(**kwargs)
         return jax.tree.map(
-            lambda leaf, spec: jax.device_put(
-                leaf, NamedSharding(self.mesh, spec)),
-            state, self._specs)
+            lambda leaf, sh: jax.device_put(leaf, sh), state, shardings)
 
     def step(self, state: LinearState, indices, values, labels):
         """One sharded train step. indices/values: [B, K]; labels: [B]
@@ -166,6 +170,102 @@ class ShardedTrainer:
 
         def predict(state: LinearState, indices, values):
             return jfn(state.weights, indices, values)
+
+        return predict
+
+
+class FMShardedTrainer:
+    """Feature-dim sharded FM training — the V table is the framework's
+    largest model state ([2^24, k] + optimizer does not fit one chip), so w
+    and V stripe [D/S] / [D/S, k] across the mesh exactly like the linear
+    ShardedTrainer: per row, each device gathers its owned lanes, the three
+    prediction partials (linear, sumVfX, sumV2X2) psum over ICI, and lane
+    updates scatter locally (models/fm.py make_fm_step feature_shard).
+    Blocks replicate (the model, not the data, is what doesn't fit).
+    Arbitrary dims pad up to stripe * n_devices."""
+
+    def __init__(self, hyper, dims: int, mesh: Optional[Mesh] = None,
+                 mode: str = "minibatch", mini_batch_average: bool = True):
+        from ..models.fm import FMHyper, init_fm_state, make_fm_step
+
+        assert isinstance(hyper, FMHyper)
+        self.hyper = hyper
+        self.dims = dims
+        self.mesh = mesh if mesh is not None else make_mesh()
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError(
+                f"FMShardedTrainer needs a 1-D mesh, got {self.mesh.axis_names}")
+        self.axis = self.mesh.axis_names[0]
+        n = self.mesh.devices.size
+        self.stripe = -(-dims // n)
+        self.dims_padded = self.stripe * n
+        self._init_fn = lambda: init_fm_state(self.dims_padded, hyper)
+
+        body = make_fm_step(hyper, mode, mini_batch_average=mini_batch_average,
+                            feature_shard=(self.axis, self.stripe))
+        state_shape = jax.eval_shape(self._init_fn)
+        dp = self.dims_padded
+        specs = jax.tree.map(
+            lambda leaf: P(*((self.axis,) + (None,) * (leaf.ndim - 1)))
+            if leaf.ndim >= 1 and leaf.shape[0] == dp else P(), state_shape)
+        self._specs = specs
+        self._step = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(specs, P(), P(), P(), P()),
+                out_specs=(specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def init(self):
+        # born sharded: jit with out_shardings so the full [D_pad, k] V table
+        # is never materialized on one device (the class exists because it
+        # wouldn't fit)
+        shardings = jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec), self._specs)
+        return jax.jit(self._init_fn, out_shardings=shardings)()
+
+    def step(self, state, indices, values, labels, va=None):
+        """indices/values: [B, K]; labels: [B] (replicated)."""
+        if va is None:
+            va = np.zeros(np.asarray(labels).shape, np.float32)
+        return self._step(state, indices, values, labels, va)
+
+    def final_state(self, state):
+        """Host-side copy with the padding sliced back off."""
+        host = jax.device_get(state)
+        dp = self.dims_padded
+        unpad = lambda x: x[: self.dims] if (
+            getattr(x, "ndim", 0) >= 1 and x.shape[0] == dp) else x
+        return jax.tree.map(unpad, host)
+
+    def make_predict(self):
+        """Serve the trained sharded state directly: the SAME
+        sharded_gather_predict body the train step uses (models/fm.py), so
+        train-time and serve-time predictions cannot drift."""
+        from ..models.fm import sharded_gather_predict
+
+        stripe, axis = self.stripe, self.axis
+
+        def local_scores(w, v, w0, idx, val):
+            _, _, _, _, p, _ = sharded_gather_predict(
+                w, v, w0, idx, val, axis, stripe)
+            return p
+
+        fn = jax.shard_map(
+            local_scores,
+            mesh=self.mesh,
+            in_specs=(P(self.axis), P(self.axis, None), P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        jfn = jax.jit(fn)
+
+        def predict(state, indices, values):
+            return jfn(state.w, state.v, state.w0, indices, values)
 
         return predict
 

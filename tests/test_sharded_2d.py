@@ -103,6 +103,54 @@ def test_2d_mix_every_gates_replica_collective():
     assert dw > 1e-6
 
 
+def test_fm_sharded_parity():
+    """Feature-dim sharded FM == single-device FM step for step: weights, V,
+    touched, loss — on non-divisible dims (padding), both modes."""
+    from hivemall_tpu.models.fm import FMHyper, init_fm_state, make_fm_step
+    from hivemall_tpu.ops.eta import fixed
+    from hivemall_tpu.parallel.sharded_train import FMShardedTrainer
+
+    dims = 1003
+    hyper = FMHyper(factors=4, classification=True, lambda0=0.01,
+                    eta=fixed(0.05), seed=2)
+    rng = np.random.RandomState(11)
+    n_blocks, B, K = 3, 32, 8
+    idx = rng.randint(0, dims, size=(n_blocks, B, K)).astype(np.int32)
+    val = rng.rand(n_blocks, B, K).astype(np.float32)
+    lab = np.sign(rng.randn(n_blocks, B)).astype(np.float32)
+    va = np.zeros((B,), np.float32)
+
+    for mode in ("minibatch", "scan"):
+        step = make_fm_step(hyper, mode)
+        ref = init_fm_state(dims, hyper)
+        for b in range(n_blocks):
+            ref, ref_loss = step(ref, idx[b], val[b], lab[b], va)
+        ref = jax.device_get(ref)
+
+        trainer = FMShardedTrainer(hyper, dims, make_mesh(8), mode=mode)
+        assert trainer.dims_padded == 1008
+        state = trainer.init()
+        for b in range(n_blocks):
+            state, loss = trainer.step(state, idx[b], val[b], lab[b])
+        got = trainer.final_state(state)
+        np.testing.assert_allclose(np.asarray(got.w), np.asarray(ref.w),
+                                   rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got.v), np.asarray(ref.v),
+                                   rtol=2e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(got.touched),
+                                      np.asarray(ref.touched))
+        assert float(got.w0) == pytest.approx(float(ref.w0), rel=1e-5)
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-4)
+
+        # trained sharded state serves directly
+        predict = trainer.make_predict()
+        scores = np.asarray(predict(state, idx[0], val[0]))
+        from hivemall_tpu.models.fm import _fm_scores
+
+        want = np.asarray(_fm_scores(ref, idx[0], val[0]))
+        np.testing.assert_allclose(scores, want, rtol=2e-5, atol=1e-5)
+
+
 def test_1d_sharded_padding_parity():
     """ShardedTrainer on non-divisible dims pads internally and still matches
     the single-device engine on the real prefix."""
