@@ -149,14 +149,10 @@ def make_train_fn(
             return _row_ctx(tables, idx, val, y, tf, use_cov, gl), idx
     else:
         shard_axis, stripe = feature_shard
+        from .striping import translate_to_stripe
 
         def build_ctx(tables, idx, val, y, tf, gl):
-            dev = jax.lax.axis_index(shard_axis)
-            local_idx = idx - dev * stripe
-            owned = (local_idx >= 0) & (local_idx < stripe)
-            # non-owned lanes route to the one-past-end drop slot
-            local_idx = jnp.where(owned, local_idx, stripe)
-            vmask = val * owned.astype(val.dtype)
+            local_idx, vmask = translate_to_stripe(idx, val, shard_axis, stripe)
             # same gathers/row scalars as the local path, on the stripe's
             # lanes only — then the scalar partials psum to global values
             ctx = _row_ctx(tables, local_idx, vmask, y, tf, use_cov, gl)

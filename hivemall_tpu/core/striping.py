@@ -1,0 +1,37 @@
+"""THE one copy of the feature-stripe index translation.
+
+Every feature-dim sharded path (linear engine, FM, multiclass, serving)
+maps global hashed ids onto a device's [stripe] table slice the same way:
+
+    local = global - device * stripe
+    owned = 0 <= local < stripe
+    foreign / pad lanes -> index `stripe` (one-past-end), which `.at[...]`
+    with mode="drop"/"fill" drops/zeroes, and their values mask to 0 so
+    they contribute nothing to partials.
+
+Changing this convention (drop slot, masking, negative handling) in one
+place changes it for training AND serving of every sharded model — the
+paths cannot drift (core/engine.py build_ctx, models/fm.py
+sharded_gather_predict, models/multiclass.py _row_quantities_sharded,
+parallel/sharded.py stripe_score all call it).
+
+Reference analog: `hash(feature) mod numNodes` server routing
+(mix/client/MixRequestRouter.java:56-60) — here the stripe is contiguous
+ranges instead of modulo so each device's slice is one dense block.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def translate_to_stripe(idx, val, shard_axis: str, stripe: int):
+    """(local_idx, masked_val): global ids -> this device's stripe-local
+    indices (foreign/pad -> the drop slot `stripe`), values masked to 0 on
+    lanes this device does not own. Works on any shape of idx/val."""
+    dev = jax.lax.axis_index(shard_axis)
+    local_idx = idx - dev * stripe
+    owned = (local_idx >= 0) & (local_idx < stripe)
+    local_idx = jnp.where(owned, local_idx, stripe)
+    return local_idx, val * owned.astype(val.dtype)

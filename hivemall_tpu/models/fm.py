@@ -109,11 +109,9 @@ def sharded_gather_predict(w, v, w0, idx, val, shard_axis: str, stripe: int):
     gather owned lanes, and combine the three prediction partials with a
     single fused psum over the stripe axis. Works on any leading batch
     shape; idx/val are [..., K]."""
-    dev = jax.lax.axis_index(shard_axis)
-    lidx = idx - dev * stripe
-    owned = (lidx >= 0) & (lidx < stripe)
-    lidx = jnp.where(owned, lidx, stripe)
-    vmask = val * owned.astype(val.dtype)
+    from ..core.striping import translate_to_stripe
+
+    lidx, vmask = translate_to_stripe(idx, val, shard_axis, stripe)
     wg = w.at[lidx].get(mode="fill", fill_value=0.0)
     vg = v.at[lidx].get(mode="fill", fill_value=0.0)
     vx = vg * vmask[..., None]

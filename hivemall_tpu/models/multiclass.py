@@ -172,10 +172,11 @@ def _take2(table, idx, fill):
     return jnp.take(table, idx, axis=1, mode="fill", fill_value=fill)
 
 
-def _row_quantities(weights, covars, idx, val, label, use_cov):
-    L = weights.shape[0]
-    W = _take2(weights, idx, 0.0)  # [L, K]
-    scores = W @ val  # [L]
+def _margin_from_scores(scores, variances, COV, label, val, use_cov):
+    """Margin / missed label / variance / cov rows from (global) per-label
+    scores — the ONE copy of the downstream selection logic shared by the
+    local and feature-sharded gathers (so their semantics cannot drift)."""
+    L = scores.shape[0]
     correct = scores[label]
     if L == 1:
         # No other label yet: the reference scores "max another" as 0 with a
@@ -188,14 +189,23 @@ def _row_quantities(weights, covars, idx, val, label, use_cov):
         missed = jnp.argmax(others)
         m = correct - others[missed]
     if use_cov:
-        COV = _take2(covars, idx, 1.0)
-        variances = COV @ (val * val)
-        var = variances[label] + jnp.where(missed == label, 0.0, variances[missed])
+        var = variances[label] + jnp.where(missed == label, 0.0,
+                                           variances[missed])
         cov_a, cov_m = COV[label], COV[missed]
     else:
         var = jnp.zeros(())
         cov_a = cov_m = jnp.ones_like(val)
     return m, var, missed, cov_a, cov_m
+
+
+def _row_quantities(weights, covars, idx, val, label, use_cov):
+    W = _take2(weights, idx, 0.0)  # [L, K]
+    scores = W @ val  # [L]
+    COV = variances = None
+    if use_cov:
+        COV = _take2(covars, idx, 1.0)
+        variances = COV @ (val * val)
+    return _margin_from_scores(scores, variances, COV, label, val, use_cov)
 
 
 def _cov_delta(kind, cov, val, alpha, beta):
@@ -207,8 +217,48 @@ def _cov_delta(kind, cov, val, alpha, beta):
     return cov / denom - cov
 
 
-def make_mc_train_step(rule: MCRule, hyper: dict, mode: str = "scan"):
+def _row_quantities_sharded(weights, covars, idx, val, label, use_cov,
+                            shard_axis, stripe):
+    """Sharded twin of _row_quantities: tables are [L, D/S] stripes; the
+    per-label score/variance partials psum over the stripe axis (one fused
+    collective), everything downstream (margin, missed label, closed-form
+    alpha/beta) is the same _margin_from_scores as the local path. Returns
+    the translated lane indices + masked values for the scatters."""
+    from ..core.striping import translate_to_stripe
+
+    lidx, vmask = translate_to_stripe(idx, val, shard_axis, stripe)
+    W = _take2(weights, lidx, 0.0)  # [L, K] owned lanes only
+    COV = variances = None
+    if use_cov:
+        COV = _take2(covars, lidx, 1.0)
+        scores, variances = jax.lax.psum(
+            (W @ vmask, COV @ (vmask * vmask)), shard_axis)
+    else:
+        scores = jax.lax.psum(W @ vmask, shard_axis)
+    m, var, missed, cov_a, cov_m = _margin_from_scores(
+        scores, variances, COV, label, val, use_cov)
+    return m, var, missed, cov_a, cov_m, lidx, vmask
+
+
+def make_mc_train_step(rule: MCRule, hyper: dict, mode: str = "scan",
+                       feature_shard: Optional[Tuple[str, int]] = None):
+    """`feature_shard=(axis_name, stripe)` runs the same step on [L, D/S]
+    table stripes inside shard_map — the multiclass analog of the engine's
+    feature-sharded training (an L-label covariance model at 2^24 dims is
+    L x 2 tables that do not fit one chip)."""
     use_cov = rule.use_covariance
+
+    if feature_shard is None:
+        def row_q(weights, covars, idx, val, label):
+            m, var, missed, cov_a, cov_m = _row_quantities(
+                weights, covars, idx, val, label, use_cov)
+            return m, var, missed, cov_a, cov_m, idx, val
+    else:
+        shard_axis, stripe = feature_shard
+
+        def row_q(weights, covars, idx, val, label):
+            return _row_quantities_sharded(weights, covars, idx, val, label,
+                                           use_cov, shard_axis, stripe)
 
     def apply_row(state_arrays, idx, val, label, alpha, beta, updated, cov_a, cov_m, missed):
         weights, covars, touched = state_arrays
@@ -233,11 +283,13 @@ def make_mc_train_step(rule: MCRule, hyper: dict, mode: str = "scan"):
         def body(carry, row):
             weights, covars, touched, t = carry
             idx, val, label = row
+            # sq_norm from the raw replicated values: a global row scalar
             sq_norm = jnp.sum(val * val)
-            m, var, missed, cov_a, cov_m = _row_quantities(weights, covars, idx, val,
-                                                           label, use_cov)
+            m, var, missed, cov_a, cov_m, sidx, eff_val = row_q(
+                weights, covars, idx, val, label)
             alpha, beta, loss, updated = rule.compute(m, var, sq_norm, hyper)
-            weights, covars, touched = apply_row((weights, covars, touched), idx, val,
+            weights, covars, touched = apply_row((weights, covars, touched),
+                                                 sidx, eff_val,
                                                  label, alpha, beta, updated, cov_a,
                                                  cov_m, missed)
             return (weights, covars, touched, t + 1), loss
@@ -253,32 +305,33 @@ def make_mc_train_step(rule: MCRule, hyper: dict, mode: str = "scan"):
 
         def per_row(idx, val, label):
             sq_norm = jnp.sum(val * val)
-            m, var, missed, cov_a, cov_m = _row_quantities(
-                state.weights, state.covars, idx, val, label, use_cov)
+            m, var, missed, cov_a, cov_m, sidx, eff_val = row_q(
+                state.weights, state.covars, idx, val, label)
             alpha, beta, loss, updated = rule.compute(m, var, sq_norm, hyper)
-            return m, missed, cov_a, cov_m, alpha, beta, loss, updated
+            return m, missed, cov_a, cov_m, alpha, beta, loss, updated, \
+                sidx, eff_val
 
-        m, missed, cov_a, cov_m, alpha, beta, loss, updated = jax.vmap(per_row)(
-            indices, values, labels)
+        (m, missed, cov_a, cov_m, alpha, beta, loss, updated, sidx,
+         eff_val) = jax.vmap(per_row)(indices, values, labels)
         upd = updated.astype(values.dtype)[:, None]
         has_miss = jnp.where(missed == labels, 0.0, 1.0)[:, None]
-        dwa = upd * alpha[:, None] * cov_a * values
-        dwm = -upd * has_miss * alpha[:, None] * cov_m * values
-        weights = state.weights.at[labels[:, None], indices].add(dwa, mode="drop")
-        weights = weights.at[missed[:, None], indices].add(dwm, mode="drop")
+        dwa = upd * alpha[:, None] * cov_a * eff_val
+        dwm = -upd * has_miss * alpha[:, None] * cov_m * eff_val
+        weights = state.weights.at[labels[:, None], sidx].add(dwa, mode="drop")
+        weights = weights.at[missed[:, None], sidx].add(dwm, mode="drop")
         covars = state.covars
         if use_cov:
             dca = upd * jax.vmap(
                 lambda c, v, a, be: _cov_delta(rule.cov_kind, c, v, a, be))(
-                    cov_a, values, alpha, beta)
+                    cov_a, eff_val, alpha, beta)
             dcm = upd * has_miss * jax.vmap(
                 lambda c, v, a, be: _cov_delta(rule.cov_kind, c, v, a, be))(
-                    cov_m, values, alpha, beta)
-            covars = covars.at[labels[:, None], indices].add(dca, mode="drop")
-            covars = covars.at[missed[:, None], indices].add(dcm, mode="drop")
-        u8 = jnp.broadcast_to(updated.astype(jnp.int8)[:, None], indices.shape)
-        touched = state.touched.at[labels[:, None], indices].max(u8, mode="drop")
-        touched = touched.at[missed[:, None], indices].max(u8, mode="drop")
+                    cov_m, eff_val, alpha, beta)
+            covars = covars.at[labels[:, None], sidx].add(dca, mode="drop")
+            covars = covars.at[missed[:, None], sidx].add(dcm, mode="drop")
+        u8 = jnp.broadcast_to(updated.astype(jnp.int8)[:, None], sidx.shape)
+        touched = state.touched.at[labels[:, None], sidx].max(u8, mode="drop")
+        touched = touched.at[missed[:, None], sidx].max(u8, mode="drop")
         return state.replace(weights=weights, covars=covars, touched=touched,
                              step=state.step + b), jnp.sum(loss)
 

@@ -34,14 +34,13 @@ def stripe_score(axis_name: str, stripe: int):
     copy of the stripe-placement math so trained-sharded and served-sharded
     states cannot drift."""
 
+    from ..core.striping import translate_to_stripe
+
     def local_score(w_local, indices, values):
-        dev = jax.lax.axis_index(axis_name)
-        local_idx = indices - dev * stripe
-        in_range = (local_idx >= 0) & (local_idx < stripe)
-        local_idx = jnp.where(in_range, local_idx, stripe)  # OOB -> dropped by fill
+        local_idx, vmask = translate_to_stripe(indices, values, axis_name,
+                                               stripe)
         w = w_local.at[local_idx].get(mode="fill", fill_value=0.0)
-        partial_scores = jnp.sum(w * values * in_range.astype(values.dtype), axis=-1)
-        return jax.lax.psum(partial_scores, axis_name)
+        return jax.lax.psum(jnp.sum(w * vmask, axis=-1), axis_name)
 
     return local_score
 

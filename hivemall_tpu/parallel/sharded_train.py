@@ -58,6 +58,41 @@ from .mix import (MixConfig, collapse_linear_replicas, grouped_mix_scan,
 from .sharded import stripe_score
 
 
+def _resolve_1d_mesh(mesh: Optional[Mesh], who: str):
+    """Shared striping scaffold: validate/construct the 1-D mesh and return
+    (mesh, axis_name, n_devices)."""
+    mesh = mesh if mesh is not None else make_mesh()
+    if len(mesh.axis_names) != 1:
+        raise ValueError(f"{who} needs a 1-D mesh, got axes {mesh.axis_names}")
+    return mesh, mesh.axis_names[0], mesh.devices.size
+
+
+def _born_sharded(init_fn, mesh: Mesh, specs):
+    """jit the state constructor with out_shardings so the full tables are
+    never materialized on one device (sharded trainers exist because they
+    wouldn't fit)."""
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs)
+    return jax.jit(init_fn, out_shardings=shardings)()
+
+
+def _unpad_state(host, dims: int, dims_padded: int):
+    """Slice the dims padding back off every leaf (whichever axis carries
+    it) — shared final_state tail of all sharded trainers."""
+    if dims == dims_padded:
+        return host
+
+    def unpad(x):
+        if getattr(x, "ndim", 0) >= 1:
+            for ax, size in enumerate(x.shape):
+                if size == dims_padded:
+                    sl = [slice(None)] * x.ndim
+                    sl[ax] = slice(0, dims)
+                    return x[tuple(sl)]
+        return x
+
+    return jax.tree.map(unpad, host)
+
+
 def _pad_initial(arr, dims_padded, fill=0.0):
     """Pad a user-provided [dims] warm-start array up to the sharded table
     size. Weights pad with 0; covariances pad with 1.0 (their init value) —
@@ -86,12 +121,7 @@ class ShardedTrainer:
         self.rule = rule
         self.hyper = hyper
         self.dims = dims
-        self.mesh = mesh if mesh is not None else make_mesh()
-        if len(self.mesh.axis_names) != 1:
-            raise ValueError(
-                f"ShardedTrainer needs a 1-D mesh, got axes {self.mesh.axis_names}")
-        self.axis = self.mesh.axis_names[0]
-        n = self.mesh.devices.size
+        self.mesh, self.axis, n = _resolve_1d_mesh(mesh, "ShardedTrainer")
         self.stripe = -(-dims // n)  # ceil: arbitrary dims pad up
         self.dims_padded = self.stripe * n
 
@@ -129,18 +159,15 @@ class ShardedTrainer:
         init_linear_state (initial_weights/initial_covars = -loadmodel warm
         start, ref: LearnerBaseUDTF.java:215-333); [dims] arrays pad up to
         the sharded table size."""
-        shardings = jax.tree.map(
-            lambda spec: NamedSharding(self.mesh, spec), self._specs)
         if not kwargs:
-            # born sharded: no single-device materialization of the full
-            # tables (they exist sharded precisely because they don't fit)
-            return jax.jit(self._init_one, out_shardings=shardings)()
+            return _born_sharded(self._init_one, self.mesh, self._specs)
         for key, fill in (("initial_weights", 0.0), ("initial_covars", 1.0)):
             if kwargs.get(key) is not None:
                 kwargs[key] = _pad_initial(kwargs[key], self.dims_padded, fill)
         state = self._init_one(**kwargs)
         return jax.tree.map(
-            lambda leaf, sh: jax.device_put(leaf, sh), state, shardings)
+            lambda leaf, spec: jax.device_put(
+                leaf, NamedSharding(self.mesh, spec)), state, self._specs)
 
     def step(self, state: LinearState, indices, values, labels):
         """One sharded train step. indices/values: [B, K]; labels: [B]
@@ -150,10 +177,7 @@ class ShardedTrainer:
     def final_state(self, state: LinearState) -> LinearState:
         """Host-side copy with the padding sliced back off — a plain [dims]
         model for export / warm start / init_linear_state round trips."""
-        host = jax.device_get(state)
-        unpad = lambda x: x[: self.dims] if (
-            getattr(x, "ndim", 0) == 1 and x.shape[0] == self.dims_padded) else x
-        return jax.tree.map(unpad, host)
+        return _unpad_state(jax.device_get(state), self.dims, self.dims_padded)
 
     def make_predict(self):
         """Jitted scoring that consumes the TRAINED sharded state directly —
@@ -191,12 +215,7 @@ class FMShardedTrainer:
         assert isinstance(hyper, FMHyper)
         self.hyper = hyper
         self.dims = dims
-        self.mesh = mesh if mesh is not None else make_mesh()
-        if len(self.mesh.axis_names) != 1:
-            raise ValueError(
-                f"FMShardedTrainer needs a 1-D mesh, got {self.mesh.axis_names}")
-        self.axis = self.mesh.axis_names[0]
-        n = self.mesh.devices.size
+        self.mesh, self.axis, n = _resolve_1d_mesh(mesh, "FMShardedTrainer")
         self.stripe = -(-dims // n)
         self.dims_padded = self.stripe * n
         self._init_fn = lambda: init_fm_state(self.dims_padded, hyper)
@@ -221,12 +240,7 @@ class FMShardedTrainer:
         )
 
     def init(self):
-        # born sharded: jit with out_shardings so the full [D_pad, k] V table
-        # is never materialized on one device (the class exists because it
-        # wouldn't fit)
-        shardings = jax.tree.map(
-            lambda spec: NamedSharding(self.mesh, spec), self._specs)
-        return jax.jit(self._init_fn, out_shardings=shardings)()
+        return _born_sharded(self._init_fn, self.mesh, self._specs)
 
     def step(self, state, indices, values, labels, va=None):
         """indices/values: [B, K]; labels: [B] (replicated)."""
@@ -236,11 +250,7 @@ class FMShardedTrainer:
 
     def final_state(self, state):
         """Host-side copy with the padding sliced back off."""
-        host = jax.device_get(state)
-        dp = self.dims_padded
-        unpad = lambda x: x[: self.dims] if (
-            getattr(x, "ndim", 0) >= 1 and x.shape[0] == dp) else x
-        return jax.tree.map(unpad, host)
+        return _unpad_state(jax.device_get(state), self.dims, self.dims_padded)
 
     def make_predict(self):
         """Serve the trained sharded state directly: the SAME
@@ -266,6 +276,102 @@ class FMShardedTrainer:
 
         def predict(state, indices, values):
             return jfn(state.w, state.v, state.w0, indices, values)
+
+        return predict
+
+
+class MCShardedTrainer:
+    """Feature-dim sharded MULTICLASS training: the stacked [L, D] weight
+    (and covariance) tensor stripes along the feature dim — [L, D/S] per
+    device. Per row, the per-label score/variance partials psum over the
+    stripe axis (models/multiclass.py _row_quantities_sharded), the margin
+    and closed-form alpha/beta are computed from the global scalars, and
+    the correct/missed row updates scatter into the local stripe. An
+    L-label covariance model at 2^24 dims is 2L full tables — this is what
+    makes it fit. Blocks replicate; arbitrary dims pad up."""
+
+    def __init__(self, rule, hyper: dict, num_labels: int, dims: int,
+                 mesh: Optional[Mesh] = None, mode: str = "minibatch"):
+        from ..models.multiclass import (MCRule, MulticlassState,
+                                         make_mc_train_step)
+
+        assert isinstance(rule, MCRule)
+        self.rule = rule
+        self.num_labels = num_labels
+        self.dims = dims
+        self.mesh, self.axis, n = _resolve_1d_mesh(mesh, "MCShardedTrainer")
+        self.stripe = -(-dims // n)
+        self.dims_padded = self.stripe * n
+        dp = self.dims_padded
+        L = num_labels
+
+        def init_one() -> MulticlassState:
+            return MulticlassState(
+                weights=jnp.zeros((L, dp), jnp.float32),
+                covars=jnp.ones((L, dp), jnp.float32)
+                if rule.use_covariance else None,
+                touched=jnp.zeros((L, dp), jnp.int8),
+                step=jnp.zeros((), jnp.int32),
+            )
+
+        self._init_fn = init_one
+        mc_body = make_mc_train_step(rule, hyper, mode,
+                                     feature_shard=(self.axis, self.stripe))
+
+        def body(state, indices, values, labels):
+            # labels cast on device (no host round trip on the hot path)
+            return mc_body(state, indices, values, labels.astype(jnp.int32))
+        state_shape = jax.eval_shape(init_one)
+        specs = jax.tree.map(
+            lambda leaf: P(None, self.axis)
+            if leaf.ndim == 2 and leaf.shape[-1] == dp else P(), state_shape)
+        self._specs = specs
+        self._step = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(specs, P(), P(), P()),
+                out_specs=(specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def init(self):
+        return _born_sharded(self._init_fn, self.mesh, self._specs)
+
+    def step(self, state, indices, values, labels):
+        """indices/values: [B, K]; labels: [B] int (replicated)."""
+        return self._step(state, indices, values, labels)
+
+    def final_state(self, state):
+        """Host-side copy with the padding sliced back off."""
+        return _unpad_state(jax.device_get(state), self.dims, self.dims_padded)
+
+    def make_predict(self):
+        """Per-label scores from the sharded state: local [L, K] gather +
+        one psum over the stripe axis."""
+        stripe, axis = self.stripe, self.axis
+
+        from ..core.striping import translate_to_stripe
+
+        def local_scores(weights, idx, val):
+            lidx, vmask = translate_to_stripe(idx, val, axis, stripe)
+            W = jnp.take(weights, lidx, axis=1, mode="fill",
+                         fill_value=0.0)  # [L, B, K]
+            return jax.lax.psum(jnp.einsum("lbk,bk->bl", W, vmask), axis)
+
+        fn = jax.shard_map(
+            local_scores,
+            mesh=self.mesh,
+            in_specs=(P(None, self.axis), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        jfn = jax.jit(fn)
+
+        def predict(state, indices, values):
+            return jfn(state.weights, indices, values)
 
         return predict
 

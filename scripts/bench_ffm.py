@@ -1,0 +1,70 @@
+"""FFM training throughput at the CTR shape (hashed features, 32 nnz/row,
+64 fields, k=4), HBM-staged blocks — the train_ffm counterpart of
+bench_fm.py, with and without -row_chunk activation tiling so the K^2
+pairwise memory/time tradeoff is measured on hardware.
+
+Run (real chip): python scripts/bench_ffm.py
+Run (CPU):       PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/bench_ffm.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from hivemall_tpu.models.ffm import FFMHyper, init_ffm_state, make_ffm_step
+
+    platform = jax.devices()[0].platform
+    batch = 4096
+    width = 32
+    fields = 64
+    n_blocks = 4
+    hyper = FFMHyper(factors=4, num_features=1 << 20, v_dims=1 << 22,
+                     num_fields=fields, seed=0)
+
+    rng = np.random.RandomState(0)
+    idx = (rng.zipf(1.3, size=(n_blocks, batch, width)) % (1 << 20)).astype(np.int32)
+    val = np.ones((n_blocks, batch, width), dtype=np.float32)
+    fld = rng.randint(0, fields, size=(n_blocks, batch, width)).astype(np.int32)
+    lab = np.sign(rng.randn(n_blocks, batch)).astype(np.float32)
+
+    idx_d = jnp.asarray(idx)
+    val_d = jnp.asarray(val)
+    fld_d = jnp.asarray(fld)
+    lab_d = jnp.asarray(lab)
+
+    rounds = 10 if platform != "cpu" else 2
+    for name, rc in (("untiled", None), ("row_chunk512", 512)):
+        step = make_ffm_step(hyper, "minibatch", row_chunk=rc)
+        state = init_ffm_state(hyper)
+        state, loss = step(state, idx_d[0], val_d[0], fld_d[0], lab_d[0])
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        total_rows = 0
+        for _ in range(rounds):
+            for b in range(n_blocks):
+                state, loss = step(state, idx_d[b], val_d[b], fld_d[b], lab_d[b])
+                total_rows += batch
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": f"ffm_train_throughput_k4_{width}nnz_{fields}fields_"
+                      f"{name}_{platform}",
+            "value": round(total_rows / dt, 1),
+            "unit": "rows/sec",
+            "ms_per_step": round(1e3 * dt / (rounds * n_blocks), 3),
+        }), flush=True)
+        del state
+
+
+if __name__ == "__main__":
+    main()

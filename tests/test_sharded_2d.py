@@ -151,6 +151,60 @@ def test_fm_sharded_parity():
         np.testing.assert_allclose(scores, want, rtol=2e-5, atol=1e-5)
 
 
+def test_mc_sharded_parity():
+    """Feature-dim sharded multiclass == single-device step for step:
+    weights, covars, touched, loss — covariance rule, non-divisible dims,
+    both modes."""
+    from hivemall_tpu.models.multiclass import (MC_AROW, MulticlassState,
+                                                make_mc_train_step)
+    from hivemall_tpu.parallel.sharded_train import MCShardedTrainer
+
+    dims, L = 1003, 3
+    rng = np.random.RandomState(13)
+    n_blocks, B, K = 3, 32, 8
+    idx = rng.randint(0, dims, size=(n_blocks, B, K)).astype(np.int32)
+    val = rng.rand(n_blocks, B, K).astype(np.float32)
+    lab = rng.randint(0, L, size=(n_blocks, B)).astype(np.int32)
+
+    import jax.numpy as jnp
+
+    for mode in ("minibatch", "scan"):
+        step = make_mc_train_step(MC_AROW, {"r": 0.1}, mode)
+        ref = MulticlassState(
+            weights=jnp.zeros((L, dims), jnp.float32),
+            covars=jnp.ones((L, dims), jnp.float32),
+            touched=jnp.zeros((L, dims), jnp.int8),
+            step=jnp.zeros((), jnp.int32),
+        )
+        for b in range(n_blocks):
+            ref, ref_loss = step(ref, idx[b], val[b], lab[b])
+        ref = jax.device_get(ref)
+
+        trainer = MCShardedTrainer(MC_AROW, {"r": 0.1}, num_labels=L,
+                                   dims=dims, mesh=make_mesh(8), mode=mode)
+        assert trainer.dims_padded == 1008
+        state = trainer.init()
+        for b in range(n_blocks):
+            state, loss = trainer.step(state, idx[b], val[b], lab[b])
+        got = trainer.final_state(state)
+        np.testing.assert_allclose(np.asarray(got.weights),
+                                   np.asarray(ref.weights),
+                                   rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got.covars),
+                                   np.asarray(ref.covars),
+                                   rtol=2e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(got.touched),
+                                      np.asarray(ref.touched))
+        assert float(loss) == pytest.approx(float(ref_loss), rel=1e-4)
+
+        # sharded serving: per-label scores match the host matmul
+        predict = trainer.make_predict()
+        scores = np.asarray(predict(state, idx[0], val[0]))  # [B, L]
+        W = np.asarray(got.weights)
+        want = np.stack([W[:, idx[0][r]] @ val[0][r] for r in range(B)])
+        np.testing.assert_allclose(scores, want, rtol=2e-5, atol=1e-5)
+
+
 def test_1d_sharded_padding_parity():
     """ShardedTrainer on non-divisible dims pads internally and still matches
     the single-device engine on the real prefix."""
