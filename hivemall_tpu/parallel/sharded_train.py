@@ -75,22 +75,25 @@ def _born_sharded(init_fn, mesh: Mesh, specs):
     return jax.jit(init_fn, out_shardings=shardings)()
 
 
-def _unpad_state(host, dims: int, dims_padded: int):
-    """Slice the dims padding back off every leaf (whichever axis carries
-    it) — shared final_state tail of all sharded trainers."""
+def _unpad_state(host, dims: int, dims_padded: int, specs, axis_name: str):
+    """Slice the dims padding back off every leaf — shared final_state tail
+    of all sharded trainers. The padded axis is read from each leaf's
+    PartitionSpec (the position named `axis_name`), never guessed from
+    sizes, so a non-feature axis that coincidentally equals dims_padded can
+    not be mis-sliced."""
     if dims == dims_padded:
         return host
 
-    def unpad(x):
+    def unpad(x, spec):
         if getattr(x, "ndim", 0) >= 1:
-            for ax, size in enumerate(x.shape):
-                if size == dims_padded:
+            for ax, name in enumerate(tuple(spec)):
+                if name == axis_name:
                     sl = [slice(None)] * x.ndim
                     sl[ax] = slice(0, dims)
                     return x[tuple(sl)]
         return x
 
-    return jax.tree.map(unpad, host)
+    return jax.tree.map(unpad, host, specs)
 
 
 def _pad_initial(arr, dims_padded, fill=0.0):
@@ -177,7 +180,8 @@ class ShardedTrainer:
     def final_state(self, state: LinearState) -> LinearState:
         """Host-side copy with the padding sliced back off — a plain [dims]
         model for export / warm start / init_linear_state round trips."""
-        return _unpad_state(jax.device_get(state), self.dims, self.dims_padded)
+        return _unpad_state(jax.device_get(state), self.dims,
+                            self.dims_padded, self._specs, self.axis)
 
     def make_predict(self):
         """Jitted scoring that consumes the TRAINED sharded state directly —
@@ -250,7 +254,8 @@ class FMShardedTrainer:
 
     def final_state(self, state):
         """Host-side copy with the padding sliced back off."""
-        return _unpad_state(jax.device_get(state), self.dims, self.dims_padded)
+        return _unpad_state(jax.device_get(state), self.dims,
+                            self.dims_padded, self._specs, self.axis)
 
     def make_predict(self):
         """Serve the trained sharded state directly: the SAME
@@ -346,7 +351,8 @@ class MCShardedTrainer:
 
     def final_state(self, state):
         """Host-side copy with the padding sliced back off."""
-        return _unpad_state(jax.device_get(state), self.dims, self.dims_padded)
+        return _unpad_state(jax.device_get(state), self.dims,
+                            self.dims_padded, self._specs, self.axis)
 
     def make_predict(self):
         """Per-label scores from the sharded state: local [L, K] gather +
@@ -499,9 +505,11 @@ class Sharded2DTrainer:
         padding back off, returning a plain [dims] model."""
         merged = collapse_linear_replicas(jax.device_get(state),
                                           dict(self.rule.slot_merge))
-        unpad = lambda x: x[: self.dims] if (
-            getattr(x, "ndim", 0) == 1 and x.shape[0] == self.dims_padded) else x
-        return jax.tree.map(unpad, merged)
+        # collapsed leaves lost the leading replica axis: strip it from the
+        # specs too, then slice the stripe axis they name
+        collapsed_specs = jax.tree.map(lambda s: P(*tuple(s)[1:]), self._specs)
+        return _unpad_state(merged, self.dims, self.dims_padded,
+                            collapsed_specs, self.shard_axis)
 
     def make_predict(self):
         """Serve the trained 2-D state without re-placement: replica 0's
