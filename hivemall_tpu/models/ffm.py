@@ -133,8 +133,40 @@ def _row_predict(state: FFMState, idx, val, fields, hyper: FFMHyper):
     return p, keys, Vg, xx
 
 
+def sharded_ffm_gather(st: FFMState, idx, val, fields, hyper: FFMHyper,
+                       shard_axis: str, stripe_w: int, stripe_v: int):
+    """The ONE copy of the feature-sharded FFM row gather + prediction,
+    shared by the sharded train step and the sharded serving path. Each
+    device gathers the entries it owns of the row's [K, K, k] pair block
+    (exactly one owner per hashed key) and ONE psum rebuilds the full block
+    (and its gg) everywhere. Returns (p, local_keys, Vg, xx, gg, own)."""
+    from ..core.striping import translate_to_stripe
+
+    keys = _row_pair_keys(idx, fields, hyper.v_dims)
+    dev = jax.lax.axis_index(shard_axis)
+    lkeys = keys - dev * stripe_v
+    owned = (lkeys >= 0) & (lkeys < stripe_v)
+    lkeys = jnp.where(owned, lkeys, stripe_v)
+    own = owned.astype(val.dtype)
+    Vg, gg = jax.lax.psum(
+        (st.v.at[lkeys].get(mode="fill", fill_value=0.0),
+         st.v_gg.at[lkeys].get(mode="fill", fill_value=0.0)),
+        shard_axis)
+    xx = val[:, None] * val[None, :]
+    inter = jnp.einsum("ijf,jif->ij", Vg, Vg)
+    p = jnp.sum(jnp.triu(inter * xx, 1))
+    if hyper.linear_coeff:
+        lidx, vmask = translate_to_stripe(idx, val, shard_axis, stripe_w)
+        w = st.w.at[lidx].get(mode="fill", fill_value=0.0)
+        p = p + jax.lax.psum(jnp.sum(w * vmask), shard_axis)
+    if hyper.global_bias:
+        p = p + st.w0
+    return p, lkeys, Vg, xx, gg, own
+
+
 def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
-                  row_chunk: Optional[int] = None):
+                  row_chunk: Optional[int] = None,
+                  feature_shard: Optional[Tuple[str, int, int]] = None):
     """`row_chunk` (minibatch mode only) tiles the batch's K^2 pairwise work:
     the [B, K, K, k] dV / [B, K, K] gg activations are the FFM memory hot
     spot (256MB at B=16384, K=32, k=4 — grows with the square of the field
@@ -142,7 +174,37 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
     chunk computes against the SAME block-start parameters (identical
     accumulate-then-apply semantics, tested exact vs unchunked) and
     scatter-adds into the carried tables, bounding peak activation memory at
-    [row_chunk, K, K, k]."""
+    [row_chunk, K, K, k].
+
+    `feature_shard=(axis_name, stripe_w, stripe_v)` stripes the linear
+    tables (w/z/n/touched, [num_features]) and the pairwise V tables
+    (v/v_gg, [v_dims]) across the mesh. Unlike FM, a row's pairwise term
+    needs CROSS-stripe products <V_{i,f_j}, V_{j,f_i}> — the two rows of a
+    pair can live on different devices — so each device gathers the entries
+    it owns of the row's [K, K, k] block (exactly one owner per hashed key)
+    and ONE psum reconstructs the full block everywhere; updates scatter
+    back owned entries only. Keys hash with the ORIGINAL v_dims, so the
+    model is the same function as the unsharded one."""
+
+    if feature_shard is None:
+        translate_w = None
+
+        def predict_gather(st: FFMState, idx, val, fields):
+            p, keys, Vg, xx = _row_predict(st, idx, val, fields, hyper)
+            gg = st.v_gg[keys]
+            own = jnp.ones(keys.shape, val.dtype)
+            return p, keys, Vg, xx, gg, own
+    else:
+        from ..core.striping import translate_to_stripe
+
+        shard_axis, stripe_w, stripe_v = feature_shard
+
+        def translate_w(idx, val):
+            return translate_to_stripe(idx, val, shard_axis, stripe_w)
+
+        def predict_gather(st: FFMState, idx, val, fields):
+            return sharded_ffm_gather(st, idx, val, fields, hyper,
+                                      shard_axis, stripe_w, stripe_v)
 
     def dloss_fn(p, y):
         if hyper.classification:
@@ -152,7 +214,7 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         return pc - y, 0.5 * (pc - y) ** 2
 
     def row_updates(st: FFMState, idx, val, fields, y, t):
-        p, keys, Vg, xx = _row_predict(st, idx, val, fields, hyper)
+        p, keys, Vg, xx, gg, own = predict_gather(st, idx, val, fields)
         g, loss = dloss_fn(p, y)
         K = idx.shape[0]
         # dV[i, j] = g * x_i x_j * V_{j, f_i} for i != j
@@ -160,7 +222,6 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         coeff = g * xx * offdiag  # [K, K]
         gradV = coeff[:, :, None] * jnp.transpose(Vg, (1, 0, 2))  # [K,K,k]
         # AdaGrad eta per (i,j) entry, using gg BEFORE this grad
-        gg = st.v_gg[keys]
         if hyper.use_adagrad:
             eta_v = hyper.eta0_v / jnp.sqrt(hyper.eps + gg)
         else:
@@ -168,9 +229,9 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         Vcur = Vg
         dV = -eta_v[:, :, None] * (gradV + 2.0 * hyper.lambda_v * Vcur)
         # zero out padded lanes (val == 0 kills coeff already; L2 pull must
-        # not apply to untouched entries)
+        # not apply to untouched entries) and, sharded, foreign entries
         lane = (val != 0.0).astype(val.dtype)
-        pair_real = lane[:, None] * lane[None, :] * offdiag
+        pair_real = lane[:, None] * lane[None, :] * offdiag * own
         dV = dV * pair_real[:, :, None]
         dgg = jnp.sum(gradV * gradV, axis=-1) * pair_real  # entry-level gg sum
         return p, g, loss, keys, dV, dgg
@@ -202,21 +263,25 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
             idx, val, fld, y = row
             t = (st.step + 1).astype(jnp.float32)
             p, g, loss, keys, dV, dgg = row_updates(st, idx, val, fld, y, t)
-            v = st.v.at[keys.reshape(-1)].add(dV.reshape(-1, dV.shape[-1]))
-            v_gg = st.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1))
+            widx, wval = (idx, val) if translate_w is None \
+                else translate_w(idx, val)
+            v = st.v.at[keys.reshape(-1)].add(
+                dV.reshape(-1, dV.shape[-1]), mode="drop")
+            v_gg = st.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1),
+                                                    mode="drop")
             st = st.replace(v=v, v_gg=v_gg, step=st.step + 1)
             if hyper.linear_coeff:
-                dz, dn, w_new = w_updates(st, idx, val, g, t)
+                dz, dn, w_new = w_updates(st, widx, wval, g, t)
                 st = st.replace(
-                    z=st.z.at[idx].add(dz, mode="drop"),
-                    n=st.n.at[idx].add(dn, mode="drop"),
-                    w=st.w.at[idx].set(w_new, mode="drop"),
+                    z=st.z.at[widx].add(dz, mode="drop"),
+                    n=st.n.at[widx].add(dn, mode="drop"),
+                    w=st.w.at[widx].set(w_new, mode="drop"),
                 )
             if hyper.global_bias:
                 eta = hyper.eta.eta(t)
                 st = st.replace(w0=st.w0 - eta * (g + 2.0 * hyper.lambda_w * st.w0))
-            touched = st.touched.at[idx].max(
-                jnp.ones_like(idx, dtype=jnp.int8), mode="drop")
+            touched = st.touched.at[widx].max(
+                jnp.ones_like(widx, dtype=jnp.int8), mode="drop")
             return st.replace(touched=touched), loss
 
         state, losses = jax.lax.scan(body, state, (indices, values, fields, labels))
@@ -231,22 +296,26 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         p, g, loss, keys, dV, dgg = jax.vmap(
             lambda i, v, f, y, t: row_updates(base, i, v, f, y, t))(
                 idx, val, fld, lab, ts)
+        widx, wval = (idx, val) if translate_w is None \
+            else jax.vmap(translate_w)(idx, val)
         k = dV.shape[-1]
         carry = carry.replace(
-            v=carry.v.at[keys.reshape(-1)].add(dV.reshape(-1, k)),
-            v_gg=carry.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1)),
+            v=carry.v.at[keys.reshape(-1)].add(dV.reshape(-1, k),
+                                               mode="drop"),
+            v_gg=carry.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1),
+                                                     mode="drop"),
         )
         if hyper.linear_coeff:
             dz, dn, w_new = jax.vmap(
                 lambda i, v_, g_, t: w_updates(base, i, v_, g_, t))(
-                    idx, val, g, ts)
+                    widx, wval, g, ts)
             carry = carry.replace(
-                z=carry.z.at[idx].add(dz, mode="drop"),
-                n=carry.n.at[idx].add(dn, mode="drop"),
-                w=carry.w.at[idx].set(w_new, mode="drop"),
+                z=carry.z.at[widx].add(dz, mode="drop"),
+                n=carry.n.at[widx].add(dn, mode="drop"),
+                w=carry.w.at[widx].set(w_new, mode="drop"),
             )
-        carry = carry.replace(touched=carry.touched.at[idx].max(
-            jnp.ones_like(idx, dtype=jnp.int8), mode="drop"))
+        carry = carry.replace(touched=carry.touched.at[widx].max(
+            jnp.ones_like(widx, dtype=jnp.int8), mode="drop"))
         return carry, jnp.sum(loss), jnp.sum(g)
 
     def apply_w0(st: FFMState, base: FFMState, g_sum, b, t_last):

@@ -1,4 +1,5 @@
 from .mesh import make_mesh, make_mesh_2d  # noqa: F401
 from .mix import MixConfig, MixTrainer, mix_average, mix_argmin_kld  # noqa: F401
-from .sharded_train import (FMShardedTrainer, MCShardedTrainer,  # noqa: F401
-                            Sharded2DTrainer, ShardedTrainer)
+from .sharded_train import (FFMShardedTrainer, FMShardedTrainer,  # noqa: F401
+                            MCShardedTrainer, Sharded2DTrainer,
+                            ShardedTrainer)

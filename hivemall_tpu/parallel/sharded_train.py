@@ -285,6 +285,139 @@ class FMShardedTrainer:
         return predict
 
 
+class FFMShardedTrainer:
+    """Feature-dim sharded FFM training: the linear tables ([num_features])
+    and the hashed pairwise V tables ([v_dims, k] + gg) stripe across the
+    mesh with independent stripe sizes. A row's [K, K, k] pairwise block is
+    reconstructed on every device with one psum of the owner-gathered
+    entries (models/ffm.py make_ffm_step feature_shard), updates scatter
+    back owned entries only, and keys hash with the ORIGINAL v_dims so the
+    sharded model computes the same function as the unsharded one. Supports
+    row_chunk tiling on top (the two compose: the psum moves [C, K, K, k]
+    per chunk). Blocks replicate; both tables pad to their stripe grids.
+
+    `init(from_state=...)` seeds from an (unsharded) host FFMState — the
+    parity/warm-start path; the default init draws V ~ N(0, sigma) at the
+    padded shape (same distribution as unsharded, different draw)."""
+
+    def __init__(self, hyper, mesh: Optional[Mesh] = None,
+                 mode: str = "minibatch", row_chunk: Optional[int] = None):
+        from ..models.ffm import FFMHyper, FFMState, make_ffm_step
+
+        assert isinstance(hyper, FFMHyper)
+        self.hyper = hyper
+        self.mesh, self.axis, n = _resolve_1d_mesh(mesh, "FFMShardedTrainer")
+        self.stripe_w = -(-hyper.num_features // n)
+        self.stripe_v = -(-hyper.v_dims // n)
+        self.nf_padded = self.stripe_w * n
+        self.dv_padded = self.stripe_v * n
+
+        def init_one() -> FFMState:
+            key = jax.random.PRNGKey(hyper.seed)
+            return FFMState(
+                w0=jnp.zeros(()),
+                w=jnp.zeros((self.nf_padded,)),
+                z=jnp.zeros((self.nf_padded,)),
+                n=jnp.zeros((self.nf_padded,)),
+                v=jax.random.normal(key, (self.dv_padded, hyper.factors))
+                * hyper.sigma,
+                v_gg=jnp.zeros((self.dv_padded,)),
+                touched=jnp.zeros((self.nf_padded,), jnp.int8),
+                step=jnp.zeros((), jnp.int32),
+            )
+
+        self._init_fn = init_one
+        body = make_ffm_step(hyper, mode, row_chunk=row_chunk,
+                             feature_shard=(self.axis, self.stripe_w,
+                                            self.stripe_v))
+        state_shape = jax.eval_shape(init_one)
+        striped = {self.nf_padded, self.dv_padded}
+        specs = jax.tree.map(
+            lambda leaf: P(*((self.axis,) + (None,) * (leaf.ndim - 1)))
+            if leaf.ndim >= 1 and leaf.shape[0] in striped else P(),
+            state_shape)
+        self._specs = specs
+        self._step = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=self.mesh,
+                in_specs=(specs, P(), P(), P(), P()),
+                out_specs=(specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    def init(self, from_state=None):
+        if from_state is None:
+            return _born_sharded(self._init_fn, self.mesh, self._specs)
+        host = jax.device_get(from_state)
+        nf, dv = self.hyper.num_features, self.hyper.v_dims
+        padded = host.replace(
+            w=_pad_initial(np.asarray(host.w), self.nf_padded),
+            z=_pad_initial(np.asarray(host.z), self.nf_padded),
+            n=_pad_initial(np.asarray(host.n), self.nf_padded),
+            v=np.pad(np.asarray(host.v),
+                     ((0, self.dv_padded - dv), (0, 0))),
+            v_gg=_pad_initial(np.asarray(host.v_gg), self.dv_padded),
+            touched=np.pad(np.asarray(host.touched),
+                           (0, self.nf_padded - nf)),
+        )
+        return jax.tree.map(
+            lambda leaf, spec: jax.device_put(
+                leaf, NamedSharding(self.mesh, spec)), padded, self._specs)
+
+    def step(self, state, indices, values, fields, labels):
+        """indices/values/fields: [B, K]; labels: [B] (replicated)."""
+        return self._step(state, indices, values, fields, labels)
+
+    def make_predict(self):
+        """Serve the trained sharded state directly — the SAME
+        sharded_ffm_gather body the train step uses, vmapped over the
+        batch, so serving never materializes the full V table."""
+        from ..models.ffm import sharded_ffm_gather
+
+        hyper, axis = self.hyper, self.axis
+        sw, sv = self.stripe_w, self.stripe_v
+
+        def local_scores(st, idx, val, fld):
+            def one(i, v, f):
+                p, *_ = sharded_ffm_gather(st, i, v, f, hyper, axis, sw, sv)
+                return p
+
+            return jax.vmap(one)(idx, val, fld)
+
+        fn = jax.shard_map(
+            local_scores,
+            mesh=self.mesh,
+            in_specs=(self._specs, P(), P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        jfn = jax.jit(fn)
+
+        def predict(state, indices, values, fields):
+            return jfn(state, indices, values, fields)
+
+        return predict
+
+    def final_state(self, state):
+        """Host-side copy with both paddings sliced back off. FFM carries
+        TWO independently padded table families (linear at num_features, V
+        at v_dims), so the unpad is field-wise rather than the shared
+        spec-driven helper (which assumes one padded extent)."""
+        host = jax.device_get(state)
+        nf, dv = self.hyper.num_features, self.hyper.v_dims
+        return host.replace(
+            w=np.asarray(host.w)[: nf],
+            z=np.asarray(host.z)[: nf],
+            n=np.asarray(host.n)[: nf],
+            touched=np.asarray(host.touched)[: nf],
+            v=np.asarray(host.v)[: dv],
+            v_gg=np.asarray(host.v_gg)[: dv],
+        )
+
+
 class MCShardedTrainer:
     """Feature-dim sharded MULTICLASS training: the stacked [L, D] weight
     (and covariance) tensor stripes along the feature dim — [L, D/S] per
