@@ -138,7 +138,8 @@ def _dloss_and_loss(p, y, hyper: FMHyper):
 
 def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
                  mini_batch_average: bool = True,
-                 feature_shard: Optional[Tuple[str, int]] = None):
+                 feature_shard: Optional[Tuple[str, int]] = None,
+                 jit: bool = True):
     """Jitted FM block update. scan = reference-exact sequential; minibatch =
     accumulate-then-apply against block-start parameters.
 
@@ -282,7 +283,10 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
             )
         return new_state, jnp.sum(theta * loss)
 
-    return jax.jit(scan_step if mode == "scan" else minibatch_step, donate_argnums=(0,))
+    step = scan_step if mode == "scan" else minibatch_step
+    # jit=False returns the raw traceable fn for embedding in an outer scan
+    # (e.g. a whole-epoch lax.scan over staged blocks, scripts/bench_ctr_e2e.py)
+    return jax.jit(step, donate_argnums=(0,)) if jit else step
 
 
 @jax.jit
