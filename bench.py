@@ -63,17 +63,10 @@ def _measure() -> None:
     val_d = jnp.asarray(val)
     lab_d = jnp.asarray(lab)
 
+    from hivemall_tpu.core.engine import make_epoch
+
     fn = make_train_fn(AROW, {"r": 0.1}, mode="minibatch")
-
-    from functools import partial
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def epoch(state, idx, val, lab):
-        def body(s, blk):
-            s, loss = fn(s, *blk)
-            return s, loss
-
-        return jax.lax.scan(body, state, (idx, val, lab))
+    epoch = make_epoch(fn)
 
     state = init_linear_state(dims, use_covariance=True)
 

@@ -166,7 +166,8 @@ def sharded_ffm_gather(st: FFMState, idx, val, fields, hyper: FFMHyper,
 
 def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
                   row_chunk: Optional[int] = None,
-                  feature_shard: Optional[Tuple[str, int, int]] = None):
+                  feature_shard: Optional[Tuple[str, int, int]] = None,
+                  jit: bool = True):
     """`row_chunk` (minibatch mode only) tiles the batch's K^2 pairwise work:
     the [B, K, K, k] dV / [B, K, K] gg activations are the FFM memory hot
     spot (256MB at B=16384, K=32, k=4 — grows with the square of the field
@@ -365,7 +366,9 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         fn = chunked_minibatch_step
     else:
         fn = minibatch_step
-    return jax.jit(fn, donate_argnums=(0,))
+    # jit=False returns the raw traceable fn for embedding in an outer scan
+    # (e.g. a whole-epoch lax.scan over staged blocks, scripts/bench_ffm.py)
+    return jax.jit(fn, donate_argnums=(0,)) if jit else fn
 
 
 def _ffm_scores(state: FFMState, hyper: FFMHyper, indices, values, fields):

@@ -94,27 +94,37 @@ def eval_logloss(scores, labels01):
     return -jnp.mean(labels01 * jnp.log(p) + (1 - labels01) * jnp.log1p(-p)), p
 
 
+def eval_held_out(score_fn, test_blocks):
+    """Held-out logloss + flat (p_hat, y01) arrays over stacked test blocks;
+    `score_fn(idx_block) -> scores [B]`."""
+    import jax.numpy as jnp
+
+    te_idx, te_lab, _ = test_blocks
+    lls, ps, labs = [], [], []
+    for b in range(te_idx.shape[0]):
+        score = score_fn(te_idx[b])
+        y01 = (te_lab[b] + 1.0) * 0.5
+        ll, p = eval_logloss(score, y01)
+        lls.append(ll)
+        ps.append(p)
+        labs.append(y01)
+    logloss = float(jnp.mean(jnp.stack(lls)))
+    return logloss, np.concatenate([np.asarray(x) for x in ps]), \
+        np.concatenate([np.asarray(x) for x in labs])
+
+
 def run_arow(train_blocks, test_blocks, epochs, values):
     import jax
     import jax.numpy as jnp
-    from functools import partial
 
-    from hivemall_tpu.core.engine import make_predict, make_train_fn
+    from hivemall_tpu.core.engine import make_epoch, make_predict, make_train_fn
     from hivemall_tpu.core.state import init_linear_state
     from hivemall_tpu.models.classifier import AROW
 
     fn = make_train_fn(AROW, {"r": 0.1}, mode="minibatch")
     predict = make_predict(use_covariance=True)
     tr_idx, tr_lab, _ = train_blocks
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def epoch(state, idx, lab):
-        def body(s, blk):
-            bidx, blab = blk
-            s, loss = fn(s, bidx, values, blab)
-            return s, loss
-
-        return jax.lax.scan(body, state, (idx, lab))
+    epoch = make_epoch(lambda s, bidx, blab: fn(s, bidx, values, blab))
 
     # AOT-compile the epoch without executing it (donated args); the timing
     # loop calls the compiled executable directly
@@ -126,43 +136,26 @@ def run_arow(train_blocks, test_blocks, epochs, values):
     t0 = time.perf_counter()
     for _ in range(epochs):
         state, losses = epoch_c(state, tr_idx, tr_lab)
-    jax.block_until_ready(losses)
+    jax.block_until_ready(state)
     train_s = time.perf_counter() - t0
 
-    te_idx, te_lab, _ = test_blocks
-    lls, ps, labs = [], [], []
-    for b in range(te_idx.shape[0]):
-        score, _var = predict(state, te_idx[b], values)
-        y01 = (te_lab[b] + 1.0) * 0.5
-        ll, p = eval_logloss(score, y01)
-        lls.append(ll)
-        ps.append(p)
-        labs.append(y01)
-    logloss = float(jnp.mean(jnp.stack(lls)))
-    return train_s, logloss, np.concatenate([np.asarray(x) for x in ps]), \
-        np.concatenate([np.asarray(x) for x in labs])
+    logloss, p_hat, y01 = eval_held_out(
+        lambda bidx: predict(state, bidx, values)[0], test_blocks)
+    return train_s, logloss, p_hat, y01
 
 
 def run_fm(train_blocks, test_blocks, epochs, values):
     import jax
     import jax.numpy as jnp
-    from functools import partial
 
+    from hivemall_tpu.core.engine import make_epoch
     from hivemall_tpu.models.fm import FMHyper, init_fm_state, make_fm_step
 
     hyper = FMHyper(factors=5, classification=True)
     fm_fn = make_fm_step(hyper, mode="minibatch", jit=False)
     va = jnp.zeros((BATCH,), jnp.float32)
     tr_idx, tr_lab, _ = train_blocks
-
-    @partial(jax.jit, donate_argnums=(0,))
-    def epoch(state, idx, lab):
-        def body(s, blk):
-            bidx, blab = blk
-            s, loss = fm_fn(s, bidx, values, blab, va)
-            return s, loss
-
-        return jax.lax.scan(body, state, (idx, lab))
+    epoch = make_epoch(lambda s, bidx, blab: fm_fn(s, bidx, values, blab, va))
 
     warm = init_fm_state(DIMS, hyper)
     epoch_c = epoch.lower(warm, tr_idx, tr_lab).compile()
@@ -172,7 +165,7 @@ def run_fm(train_blocks, test_blocks, epochs, values):
     t0 = time.perf_counter()
     for _ in range(epochs):
         state, losses = epoch_c(state, tr_idx, tr_lab)
-    jax.block_until_ready(losses)
+    jax.block_until_ready(state)
     train_s = time.perf_counter() - t0
 
     @jax.jit
@@ -184,18 +177,9 @@ def run_fm(train_blocks, test_blocks, epochs, values):
         sum_v2x2 = jnp.einsum("bkf,bk->bf", vg * vg, val * val)
         return linear + 0.5 * jnp.sum(sum_vfx ** 2 - sum_v2x2, axis=1)
 
-    te_idx, te_lab, _ = test_blocks
-    lls, ps, labs = [], [], []
-    for b in range(te_idx.shape[0]):
-        score = fm_scores(state, te_idx[b], values)
-        y01 = (te_lab[b] + 1.0) * 0.5
-        ll, p = eval_logloss(score, y01)
-        lls.append(ll)
-        ps.append(p)
-        labs.append(y01)
-    logloss = float(jnp.mean(jnp.stack(lls)))
-    return train_s, logloss, np.concatenate([np.asarray(x) for x in ps]), \
-        np.concatenate([np.asarray(x) for x in labs])
+    logloss, p_hat, y01 = eval_held_out(
+        lambda bidx: fm_scores(state, bidx, values), test_blocks)
+    return train_s, logloss, p_hat, y01
 
 
 def main():

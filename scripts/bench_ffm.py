@@ -42,23 +42,27 @@ def main() -> None:
     fld_d = jnp.asarray(fld)
     lab_d = jnp.asarray(lab)
 
+    from hivemall_tpu.core.engine import make_epoch
+
     rounds = 10 if platform != "cpu" else 2
     for name, rc in (("untiled", None), ("row_chunk512", 512)):
-        step = make_ffm_step(hyper, "minibatch", row_chunk=rc)
+        fn = make_ffm_step(hyper, "minibatch", row_chunk=rc, jit=False)
+        # one epoch = one dispatch (device-resident scan over staged blocks)
+        epoch = make_epoch(fn)
+
         state = init_ffm_state(hyper)
-        state, loss = step(state, idx_d[0], val_d[0], fld_d[0], lab_d[0])
-        jax.block_until_ready(loss)
+        state, losses = epoch(state, idx_d, val_d, fld_d, lab_d)
+        jax.block_until_ready(losses)
         t0 = time.perf_counter()
         total_rows = 0
         for _ in range(rounds):
-            for b in range(n_blocks):
-                state, loss = step(state, idx_d[b], val_d[b], fld_d[b], lab_d[b])
-                total_rows += batch
-        jax.block_until_ready(loss)
+            state, losses = epoch(state, idx_d, val_d, fld_d, lab_d)
+            total_rows += n_blocks * batch
+        jax.block_until_ready(losses)
         dt = time.perf_counter() - t0
         print(json.dumps({
             "metric": f"ffm_train_throughput_k4_{width}nnz_{fields}fields_"
-                      f"{name}_{platform}",
+                      f"{name}_device_scan_{platform}",
             "value": round(total_rows / dt, 1),
             "unit": "rows/sec",
             "ms_per_step": round(1e3 * dt / (rounds * n_blocks), 3),

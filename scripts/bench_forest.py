@@ -4,10 +4,14 @@ vs the per-tree loop (grow_tree) on the same bootstrap bags.
 Usage: python scripts/bench_forest.py [N] [F] [T]
 """
 
+import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from hivemall_tpu.models.trees.binning import bin_data, make_bins
 from hivemall_tpu.models.trees.grow import grow_forest, grow_tree
@@ -58,6 +62,17 @@ def main():
     print(f"rows={N} features={F} trees={T} nodes batched={nodes} per-tree={nodes_solo}")
     print(f"batched grow_forest: {t_batched:.2f}s   per-tree grow_tree loop: "
           f"{t_per_tree:.2f}s   speedup {t_per_tree / t_batched:.2f}x")
+    import jax
+
+    print(json.dumps({
+        "metric": f"forest_grow_{T}trees_{N}rows_{F}feat_depth10_batched_"
+                  f"{jax.devices()[0].platform}",
+        "value": round(t_batched, 3),
+        "unit": "sec",
+        "per_tree_loop_sec": round(t_per_tree, 3),
+        "batched_speedup": round(t_per_tree / t_batched, 2),
+        "nodes": int(nodes),
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -2,7 +2,7 @@
 # Watch the axon TPU relay; whenever it serves, run whatever is left of the
 # pending hardware suite, appending one JSON line per metric to
 # PERF_TPU_r03.jsonl. Each benchmark is retried on the next uptime window
-# until it has produced output or the deadline passes.
+# until it has produced TPU-labeled output or the deadline passes.
 #
 # The relay drops unpredictably (see PERF.md "relay status"); this watcher
 # makes relay-uptime windows productive without a human in the loop:
@@ -10,9 +10,22 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT=PERF_TPU_r03.jsonl
-DONE_DIR=/tmp/relay_watch_done
+# versioned so markers written by an older watcher's laxer success criteria
+# can never retire a benchmark under the current ones
+DONE_DIR=/tmp/relay_watch_done_v2
 mkdir -p "$DONE_DIR"
 DEADLINE=$(( $(date +%s) + 4*3600 ))
+
+publish() {  # append lines from $1 to $OUT, skipping already-present metrics
+  local line metric
+  while IFS= read -r line; do
+    metric=$(printf '%s\n' "$line" | sed -n 's/.*"metric": "\([^"]*\)".*/\1/p')
+    if [ -n "$metric" ] && grep -qF "\"$metric\"" "$OUT" 2>/dev/null; then
+      continue
+    fi
+    printf '%s\n' "$line" >> "$OUT"
+  done < "$1"
+}
 
 probe() {
   timeout 90 python -c "import jax; assert jax.devices()[0].platform=='tpu'" \
@@ -24,19 +37,31 @@ run_one() {  # run_one <tag> <cmd...>
   [ -e "$DONE_DIR/$tag" ] && return 0
   probe || return 1
   echo "[$(date +%T)] running $tag" >&2
-  local before after rc
-  before=$(wc -l < "$OUT" 2>/dev/null || echo 0)
-  # python -u + line-buffered grep so partial progress survives a drop
+  local tmp rc
+  tmp=$(mktemp)
+  # python -u + line-buffered grep so partial progress survives a drop; TPU
+  # lines are published even from failed runs (dedup by metric name keeps
+  # retries from stacking conflicting records), but only a clean rc=0 run
+  # retires the tag
   set -o pipefail
   timeout 900 "$@" 2>>/tmp/relay_watch_err.log \
-    | grep --line-buffered '^{' >> "$OUT"
+    | grep --line-buffered '^{' > "$tmp"
   rc=$?
   set +o pipefail
-  after=$(wc -l < "$OUT" 2>/dev/null || echo 0)
-  echo "[$(date +%T)] $tag rc=$rc lines=$((after - before))" >&2
-  if [ "$rc" -eq 0 ] && [ "$after" -gt "$before" ]; then
-    touch "$DONE_DIR/$tag"
+  # a CPU-fallback or zero-value run must not retire the tag or publish:
+  # every script embeds the jax platform in its metric name
+  if grep -q '_tpu' "$tmp"; then
+    publish "$tmp"
+    if [ "$rc" -eq 0 ]; then
+      touch "$DONE_DIR/$tag"
+      echo "[$(date +%T)] $tag done ($(wc -l < "$tmp") lines)" >&2
+    else
+      echo "[$(date +%T)] $tag partial rc=$rc ($(wc -l < "$tmp") lines kept)" >&2
+    fi
+  else
+    echo "[$(date +%T)] $tag failed rc=$rc (no tpu lines)" >&2
   fi
+  rm -f "$tmp"
 }
 
 all_done() {

@@ -63,3 +63,35 @@ def test_minibatch1_equals_scan(rule, hyper, binary):
         np.testing.assert_allclose(np.asarray(s1.covars), np.asarray(s2.covars),
                                    rtol=2e-5, atol=1e-6)
     assert int(s1.step) == int(s2.step)
+
+
+def test_make_epoch_equals_step_loop():
+    """One jitted scan-epoch over stacked blocks == the per-block step loop
+    (make_epoch is the deployment shape used by bench.py/bench_ctr_e2e)."""
+    from hivemall_tpu.core.engine import make_epoch, make_train_fn, make_train_step
+
+    d, n_blocks, b = 16, 5, 8
+    rng = np.random.RandomState(7)
+    idx = rng.randint(0, d, size=(n_blocks, b, 3)).astype(np.int32)
+    val = rng.randn(n_blocks, b, 3).astype(np.float32)
+    y = np.sign(rng.randn(n_blocks, b)).astype(np.float32)
+
+    fn = make_train_fn(C.AROW, {"r": 0.1}, mode="minibatch")
+    epoch = make_epoch(fn, donate=False)
+    st_e = init_linear_state(d, use_covariance=True)
+    st_e, losses = epoch(st_e, idx, val, y)
+
+    step = make_train_step(C.AROW, {"r": 0.1}, mode="minibatch", donate=False)
+    st_s = init_linear_state(d, use_covariance=True)
+    loop_losses = []
+    for i in range(n_blocks):
+        st_s, loss = step(st_s, idx[i], val[i], y[i])
+        loop_losses.append(float(loss))
+
+    np.testing.assert_allclose(np.asarray(st_e.weights), np.asarray(st_s.weights),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(st_e.covars), np.asarray(st_s.covars),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(loop_losses),
+                               rtol=1e-5, atol=1e-6)
+    assert int(st_e.step) == int(st_s.step)
