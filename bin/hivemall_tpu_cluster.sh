@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Fleet control for a hivemall-tpu multi-host cluster: fan the per-host
+# worker daemon out over ssh to every host in conf/WORKER_LIST.
+#
+# TPU-native counterpart of the reference's MIX fleet control
+# (ref: bin/mixserv_cluster.sh:44-56 — ssh loop over conf/MIXSERV_LIST).
+# Differences by design: there is no server/client split — every host is an
+# identical SPMD worker; the FIRST host doubles as the coordination-service
+# address (runtime/cluster.py), and proc ids are assigned by list order.
+#
+# Usage: hivemall_tpu_cluster.sh (start|stop|status)
+set -u
+
+HOME_DIR=${HIVEMALL_TPU_HOME:-$(cd "$(dirname "$0")/.." && pwd)}
+[ -f "$HOME_DIR/conf/cluster_env.sh" ] && . "$HOME_DIR/conf/cluster_env.sh"
+
+WORKER_LIST=${HIVEMALL_TPU_WORKER_LIST:-$HOME_DIR/conf/WORKER_LIST}
+COORD_PORT=${HIVEMALL_TPU_COORD_PORT:-11212}
+SSH_OPTS=${HIVEMALL_TPU_SSH_OPTS:--o StrictHostKeyChecking=no}
+
+cmd=${1:-}
+case $cmd in
+  start|stop|status) ;;
+  *) echo "Usage: $0 (start|stop|status)"; exit 1 ;;
+esac
+
+if [ -f "$WORKER_LIST" ]; then
+  # strip comments and blank lines; one host per line, list order = proc id
+  mapfile -t hosts < <(sed 's/#.*$//; /^[[:space:]]*$/d' "$WORKER_LIST")
+else
+  hosts=(localhost)
+fi
+n=${#hosts[@]}
+coordinator="${hosts[0]}:$COORD_PORT"
+
+i=0
+for host in "${hosts[@]}"; do
+  if [ "$cmd" = start ]; then
+    remote_cmd="'$HOME_DIR/bin/hivemall_tpu_daemon.sh' start '$coordinator' $n $i"
+  else
+    remote_cmd="'$HOME_DIR/bin/hivemall_tpu_daemon.sh' $cmd"
+  fi
+  # shellcheck disable=SC2086  # SSH_OPTS is intentionally word-split
+  ssh $SSH_OPTS "$host" "$remote_cmd" 2>&1 | sed "s/^/$host: /" &
+  i=$((i + 1))
+done
+wait

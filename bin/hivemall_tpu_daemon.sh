@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# Per-host worker control for a hivemall-tpu cluster: start|stop|status.
+#
+# TPU-native counterpart of the reference's per-host MIX daemon control
+# (ref: bin/mixserv_daemon.sh — pid file + rotated log + nohup'd server jar).
+# Here the long-lived process is an SPMD jax worker: the launcher joins the
+# coordination service and then runs $HIVEMALL_TPU_APP (a training program;
+# defaults to the report-only cluster join) under `runtime.launch`.
+#
+# Usage: hivemall_tpu_daemon.sh start <coordinator> <num_procs> <proc_id>
+#        hivemall_tpu_daemon.sh (stop|status)
+set -u
+
+HOME_DIR=${HIVEMALL_TPU_HOME:-$(cd "$(dirname "$0")/.." && pwd)}
+[ -f "$HOME_DIR/conf/cluster_env.sh" ] && . "$HOME_DIR/conf/cluster_env.sh"
+
+PY=${HIVEMALL_TPU_PYTHON:-python}
+APP=${HIVEMALL_TPU_APP:-}
+PID_FILE=${HIVEMALL_TPU_PID_FILE:-/tmp/hivemall-tpu-worker-${USER:-root}.pid}
+LOG_DIR=${HIVEMALL_TPU_LOG_DIR:-$HOME_DIR/logs}
+KEEP_LOGS=${HIVEMALL_TPU_KEEP_LOGS:-5}
+
+rotate() {
+  local log=$1 n=$KEEP_LOGS prev
+  [ -f "$log" ] || return 0
+  while [ "$n" -gt 1 ]; do
+    prev=$((n - 1))
+    [ -f "$log.$prev" ] && mv "$log.$prev" "$log.$n"
+    n=$prev
+  done
+  mv "$log" "$log.1"
+}
+
+alive() {  # alive <pid>
+  kill -0 "$1" 2>/dev/null
+}
+
+case ${1:-} in
+  start)
+    coordinator=${2:?usage: $0 start <coordinator> <num_procs> <proc_id>}
+    num_procs=${3:?num_procs required}
+    proc_id=${4:?proc_id required}
+    if [ -f "$PID_FILE" ] && alive "$(cat "$PID_FILE")"; then
+      echo "worker already running as pid $(cat "$PID_FILE")"
+      exit 0
+    fi
+    mkdir -p "$LOG_DIR"
+    log="$LOG_DIR/worker-${proc_id}-$(hostname).log"
+    rotate "$log"
+    echo "starting worker $proc_id/$num_procs -> $coordinator (log: $log)"
+    # shellcheck disable=SC2086  # APP is intentionally word-split
+    nohup "$PY" -m hivemall_tpu.runtime.launch \
+      --coordinator "$coordinator" --num-procs "$num_procs" \
+      --proc-id "$proc_id" $APP > "$log" 2>&1 &
+    echo $! > "$PID_FILE"
+    sleep 1
+    if ! alive "$(cat "$PID_FILE")"; then
+      echo "worker exited immediately; tail of $log:"
+      tail -5 "$log"
+      exit 1
+    fi
+    ;;
+  stop)
+    if [ -f "$PID_FILE" ] && alive "$(cat "$PID_FILE")"; then
+      pid=$(cat "$PID_FILE")
+      kill "$pid"
+      # wait for exit; a worker wedged in a native collective defers
+      # SIGTERM handling — escalate so the pid file never outlives a
+      # still-running process (a stale file + fresh start would race two
+      # workers for the same chip/coordinator port)
+      for _ in 1 2 3 4 5 6 7 8 9 10; do
+        alive "$pid" || break
+        sleep 1
+      done
+      if alive "$pid"; then
+        kill -9 "$pid"
+        sleep 1
+      fi
+      if alive "$pid"; then
+        echo "failed to stop pid $pid; pid file kept"
+        exit 1
+      fi
+      echo "stopped pid $pid"
+    else
+      echo "no running worker"
+    fi
+    rm -f "$PID_FILE"
+    ;;
+  status)
+    if [ -f "$PID_FILE" ] && alive "$(cat "$PID_FILE")"; then
+      echo "worker running as pid $(cat "$PID_FILE")"
+    else
+      echo "worker not running"
+      exit 1
+    fi
+    ;;
+  *)
+    echo "Usage: $0 (start <coordinator> <num_procs> <proc_id> | stop | status)"
+    exit 1
+    ;;
+esac

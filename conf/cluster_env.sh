@@ -1,0 +1,17 @@
+#!/bin/sh
+# Per-deployment settings for bin/hivemall_tpu_{cluster,daemon}.sh
+# (counterpart of the reference's conf/mixserv_env.sh).
+
+# The training program every worker runs after joining the cluster, as
+# launcher arguments — e.g. "examples/elastic_ctr_training.py --epochs 4"
+# or "-m my_team.train". Empty = join, report the global device view, exit
+# (a connectivity check, the `mixserv_cluster.sh status` analog).
+#HIVEMALL_TPU_APP="examples/elastic_ctr_training.py"
+
+# Coordination-service port on the first WORKER_LIST host
+# (11212 kept from the reference's MixEnv.java:21 for familiarity).
+#HIVEMALL_TPU_COORD_PORT=11212
+
+#HIVEMALL_TPU_PYTHON=python
+#HIVEMALL_TPU_LOG_DIR=
+#HIVEMALL_TPU_KEEP_LOGS=5

@@ -1,0 +1,255 @@
+"""SQLite engine binding — the in-process SQL host-engine adapter (L6).
+
+The reference's primary surface IS a SQL engine: users register ~120
+functions into Hive (ref: resources/ddl/define-all.hive) and train/score
+with queries. This module binds the same surface to SQLite, the SQL engine
+available in every CPython build — so the reference's canonical workflows
+run as actual SQL here, not through a DataFrame DSL:
+
+- `connect(...)` / `register(conn)` — install the scalar function library
+  (sigmoid, mhash, feature helpers, scaling, distances/similarities, macro
+  functions) and the streaming aggregates (logloss, mae/mse/rmse, r2, auc,
+  voted_avg, argmin_kld, max_label, ...) into a sqlite3 connection, the
+  define-all.hive analog. Aggregates wrap the evaluation layer's
+  iterate/merge/terminate partials (evaluation/metrics.py), exactly the
+  UDAF lifecycle Hive runs (ref: evaluation/LogarithmicLossUDAF.java:28).
+- `train(conn, "train_arow", src_query, options)` — run any registry
+  trainer over the rows a query yields and materialize the model as a
+  table `(feature, weight[, covar])`: the UDTF train-then-emit flow
+  (ref: BinaryOnlineClassifierUDTF.close():249-298).
+- `explode_features(conn, src_query, out)` — test features to
+  `(rowid, feature, value)` rows, enabling the reference's pure-SQL
+  inference plan — join model on feature, `sigmoid(SUM(weight*value))`
+  group by rowid (SURVEY.md §3.5) — with no framework code in the loop.
+
+Feature rows in SQL are TEXT: either space-joined "name:value" items or a
+JSON array of them (engines without array types serialize exactly this
+way; parse_features accepts both).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+from typing import Callable, List, Optional, Sequence
+
+from ..ensemble import argmin_kld, max_label, voted_avg, weight_voted_avg
+from ..evaluation.metrics import AUC, F1Score, LogLossAggregator, MAE, MSE, R2, RMSE
+from ..sql import get_function
+
+
+def parse_features(text: Optional[str]) -> List[str]:
+    """TEXT -> the list-of-"name:value" rows every trainer consumes.
+    Accepts a JSON array string or whitespace-joined items."""
+    if text is None:
+        return []
+    s = text.strip()
+    if not s:
+        return []
+    if s.startswith("["):
+        return [str(x) for x in json.loads(s)]
+    return s.split()
+
+
+def _wrap_features_in(fn: Callable) -> Callable:
+    """Adapt fn(list_of_fv, *rest) to fn(TEXT, *rest)."""
+
+    def g(text, *rest):
+        return fn(parse_features(text), *rest)
+
+    return g
+
+
+def _wrap_features_out(fn: Callable) -> Callable:
+    """Adapt a list-returning fn to return space-joined TEXT."""
+
+    def g(*args):
+        return " ".join(str(x) for x in fn(*args))
+
+    return g
+
+
+def _agg(partial_cls, arity: int):
+    """sqlite aggregate class around an iterate/merge/terminate partial
+    (the Hive GenericUDAF lifecycle, ref: NDCGUDAF.java:113-196)."""
+
+    class A:
+        def __init__(self):
+            self.p = partial_cls()
+
+        def step(self, *args):
+            if any(a is None for a in args):
+                return
+            self.p.iterate(*args)
+
+        def finalize(self):
+            try:
+                return float(self.p.terminate())
+            except ZeroDivisionError:
+                return None
+
+    return A, arity
+
+
+class _ListAgg:
+    """Collect-then-apply aggregate for the ensemble one-shots."""
+
+    fn: Callable = staticmethod(lambda xs: None)
+    arity = 1
+
+    def __init__(self):
+        self.rows = []
+
+    def step(self, *args):
+        if any(a is None for a in args):
+            return
+        self.rows.append(args[0] if len(args) == 1 else tuple(args))
+
+    def finalize(self):
+        if not self.rows:
+            return None
+        return type(self).fn(self.rows)
+
+
+def _list_agg(fn: Callable, arity: int):
+    return type(f"_Agg_{fn.__name__}", (_ListAgg,),
+                {"fn": staticmethod(fn), "arity": arity}), arity
+
+
+_SCALARS = {
+    # (sql_name, arity, registry_name or callable, marshal)
+    "sigmoid": (1, "sigmoid", None),
+    "mhash": (1, "mhash", None),
+    "idf": (2, "idf", None),
+    "tfidf": (3, "tfidf", None),
+    "max2": (2, "max2", None),
+    "min2": (2, "min2", None),
+    "rescale": (3, "rescale", None),
+    "zscore": (3, "zscore", None),
+    "extract_feature": (1, "extract_feature", None),
+    "extract_weight": (1, "extract_weight", None),
+    "feature": (2, lambda n, v: f"{n}:{v}", None),
+    "add_bias": (1, "add_bias", "features_io"),
+    "l2_normalize": (1, "l2_normalize", "features_io"),
+    "sort_by_feature": (1, "sort_by_feature", "features_io"),
+    "cosine_similarity": (2, "cosine_similarity", "features_2in"),
+    "jaccard_similarity": (2, "jaccard_similarity", "features_2in"),
+    "angular_similarity": (2, "angular_similarity", "features_2in"),
+    "euclid_similarity": (2, "euclid_similarity", "features_2in"),
+    "cosine_distance": (2, "cosine_distance", "features_2in"),
+    "euclid_distance": (2, "euclid_distance", "features_2in"),
+    "manhattan_distance": (2, "manhattan_distance", "features_2in"),
+    "jaccard_distance": (2, "jaccard_distance", "features_2in"),
+    "hamming_distance": (2, "hamming_distance", None),
+    "popcnt": (1, "popcnt", None),
+    "tokenize": (1, "tokenize", "text_to_features"),
+    "tokenize_ja": (1, "tokenize_ja", "text_to_features"),
+}
+
+
+def register(conn: sqlite3.Connection) -> sqlite3.Connection:
+    """Install the function library into `conn` (the define-all.hive
+    analog). Returns the connection for chaining."""
+    for sql_name, (arity, target, marshal) in _SCALARS.items():
+        fn = target if callable(target) else get_function(target)
+        if marshal == "features_io":
+            fn = _wrap_features_out(_wrap_features_in(fn))
+        elif marshal == "features_2in":
+            base = fn
+
+            def fn(a, b, _f=base):  # noqa: E731 - bind per-iteration
+                return _f(parse_features(a), parse_features(b))
+        elif marshal == "text_to_features":
+            fn = _wrap_features_out(fn)
+        conn.create_function(sql_name, arity, fn, deterministic=False)
+
+    class _F1TokenLists(F1Score):
+        """F1Score.iterate takes label LISTS per row; SQL hands TEXT — split
+        whitespace-joined labels so set() is over tokens, not characters."""
+
+        def iterate(self, actual, predicted):  # type: ignore[override]
+            super().iterate(str(actual).split(), str(predicted).split())
+
+    for name, (cls, arity) in {
+        "logloss": _agg(LogLossAggregator, 2),
+        "mae": _agg(MAE, 2),
+        "mse": _agg(MSE, 2),
+        "rmse": _agg(RMSE, 2),
+        "r2": _agg(R2, 2),
+        "auc": _agg(AUC, 2),
+        "f1score": _agg(_F1TokenLists, 2),
+        "voted_avg": _list_agg(voted_avg, 1),
+        "weight_voted_avg": _list_agg(weight_voted_avg, 1),
+        "max_label": _list_agg(max_label, 2),
+        "argmin_kld": _list_agg(argmin_kld, 2),
+    }.items():
+        conn.create_aggregate(name, arity, cls)
+    return conn
+
+
+def connect(database: str = ":memory:", **kw) -> sqlite3.Connection:
+    return register(sqlite3.connect(database, **kw))
+
+
+def train(conn: sqlite3.Connection, trainer: str, src_query: str,
+          options: Optional[str] = None, model_table: str = "model"):
+    """Run a registry trainer over `src_query`'s (features TEXT, label)
+    rows; materialize the model table and return the model object.
+
+    The SQL-engine flow of `INSERT ... SELECT train_arow(features, label)
+    FROM t` (ref: define-all.hive:27-28 + the UDTF emit at close,
+    BinaryOnlineClassifierUDTF.java:249-298): SQLite has no table-valued
+    UDFs, so the rewrite — pull rows, train, materialize — is explicit."""
+    fn = get_function(trainer)
+    rows = conn.execute(src_query).fetchall()
+    feats = [parse_features(r[0]) for r in rows]
+    labels = [r[1] for r in rows]
+    model = fn(feats, labels, options) if options is not None \
+        else fn(feats, labels)
+
+    from ..core.state import model_rows
+
+    out = model_rows(model.state)
+    q = conn.cursor()
+    q.execute(f"DROP TABLE IF EXISTS {model_table}")
+    if len(out) == 3 and out[2] is not None:
+        q.execute(f"CREATE TABLE {model_table} "
+                  "(feature INTEGER PRIMARY KEY, weight REAL, covar REAL)")
+        q.executemany(f"INSERT INTO {model_table} VALUES (?,?,?)",
+                      zip(map(int, out[0]), map(float, out[1]),
+                          map(float, out[2])))
+    else:
+        q.execute(f"CREATE TABLE {model_table} "
+                  "(feature INTEGER PRIMARY KEY, weight REAL)")
+        q.executemany(f"INSERT INTO {model_table} VALUES (?,?)",
+                      zip(map(int, out[0]), map(float, out[1])))
+    conn.commit()
+    return model
+
+
+def explode_features(conn: sqlite3.Connection, src_query: str,
+                     out_table: str = "exploded",
+                     num_features: Optional[int] = None) -> None:
+    """(id, features TEXT) rows -> `(rowid, feature INTEGER, value REAL)`
+    — the explode step of the reference's pure-SQL inference plan
+    (SURVEY.md §3.5). String feature names are hashed like
+    feature_hashing() (ref: ftvec/hashing/FeatureHashingUDF.java:172)."""
+    from ..utils.feature import parse_feature
+    from ..utils.hashing import DEFAULT_NUM_FEATURES, mhash
+
+    n = num_features or DEFAULT_NUM_FEATURES
+    q = conn.cursor()
+    q.execute(f"DROP TABLE IF EXISTS {out_table}")
+    q.execute(f"CREATE TABLE {out_table} "
+              "(rowid INTEGER, feature INTEGER, value REAL)")
+    ins = []
+    for rid, text in conn.execute(src_query):
+        for fv in parse_features(text):
+            name, value = parse_feature(fv)
+            try:
+                idx = int(name)
+            except ValueError:
+                idx = mhash(name, n)
+            ins.append((rid, idx, float(value)))
+    q.executemany(f"INSERT INTO {out_table} VALUES (?,?,?)", ins)
+    conn.commit()

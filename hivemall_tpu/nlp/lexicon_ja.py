@@ -82,6 +82,26 @@ _MISC_VERBS = [  # polite/formulaic chunks, IPADic-style single units
 _INTERJECTIONS = ["ありがとう", "こんにちは", "こんばんは", "おはよう",
                   "すみません", "さようなら", "はい", "いいえ"]
 
+_KATAKANA_NOUNS = [
+    # common loanwords, lexicalized like IPADic so EXTENDED mode's
+    # unknown-word unigramming (tokenizer.py) only hits genuinely OOV runs
+    "ペン", "テレビ", "ラジオ", "カメラ", "パソコン", "コンピュータ",
+    "コンピューター", "スマホ", "インターネット", "メール", "ニュース",
+    "データ", "テキスト", "ファイル", "システム", "プログラム", "モデル",
+    "テスト", "クラス", "サービス", "ネットワーク", "ソフトウェア",
+    "ハードウェア", "ユーザー", "ユーザ", "サーバー", "サーバ", "クラウド",
+    "ホテル", "レストラン", "カフェ", "コーヒー", "ビール", "ワイン",
+    "ジュース", "パン", "ケーキ", "アイス", "サラダ", "スープ", "バス",
+    "タクシー", "バイク", "ドア", "テーブル", "イス", "ベッド", "トイレ",
+    "シャワー", "エアコン", "ゲーム", "スポーツ", "サッカー", "テニス",
+    "ゴルフ", "ピアノ", "ギター", "コンサート", "パーティー", "プレゼント",
+    "アルバイト", "ビジネス", "プロジェクト", "チーム", "グループ",
+    "リスト", "ページ", "カード", "チケット", "シャツ", "ズボン", "クツ",
+    "カバン", "メートル", "キロ", "グラム", "パーセント", "エネルギー",
+    "アメリカ", "ヨーロッパ", "アジア", "フランス", "ドイツ", "イギリス",
+    "イタリア", "スペイン", "ロシア", "インド", "カナダ",
+]
+
 _ADVERBS = [
     "とても", "すごく", "少し", "ちょっと", "たくさん", "もっと", "また",
     "まだ", "もう", "すぐ", "いつも", "時々", "よく", "あまり", "全然",
@@ -201,6 +221,10 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
         add(w, AUX, _COSTS[AUX] + (len(w) - 1) * 20)
     for w in _NOUNS:
         add(w, N, _COSTS[N])
+    for w in _KATAKANA_NOUNS:
+        # below the katakana unknown-run price (lattice._UNK_COST) so the
+        # lexical analysis wins, but near it so unseen loanwords still parse
+        add(w, N, _COSTS[N] + 100)
     for w in _ADVERBS:
         add(w, ADV, _COSTS[ADV])
     for w in _CONJUNCTIONS:

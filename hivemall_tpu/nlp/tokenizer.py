@@ -125,15 +125,43 @@ def tokenize_ja(text: str, mode: str = "normal",
         # the compound with whole-token candidates suppressed (dictionary-
         # backed split); other backends fall back to kanji 2-grams.
         extra: List[str] = []
+        decompounded = set()
         for t in tokens:
             if len(t) >= 4 and all(_char_class(c) == "kanji" for c in t):
                 parts: List[str] = []
                 if _BACKEND_NAME == "lattice":
                     parts = backend.decompound(t)
-                if not parts:
+                if parts:
+                    decompounded.add(t)
+                elif mode == "search":
+                    # recall-oriented 2-gram fallback for OOV compounds;
+                    # EXTENDED skips it — its own unigram stage below covers
+                    # OOV (emitting both would duplicate every character)
                     parts = [t[i : i + 2] for i in range(len(t) - 1)]
                 extra.extend(parts)
         tokens = tokens + extra
+    if mode == "extended":
+        # EXTENDED additionally replaces UNKNOWN words with their character
+        # 1-grams (Kuromoji Mode.EXTENDED: unknown terms are n-grammed so
+        # OOV text still matches at search time; known terms pass through).
+        # "Unknown" = not a dictionary word for the lattice backend; other
+        # backends have no cheap membership test, so only multi-char
+        # katakana/latin loanword runs — the dominant OOV class — n-gram.
+        def _is_unknown(t: str) -> bool:
+            if _BACKEND_NAME == "lattice":
+                return t not in backend.lexicon
+            cls = {_char_class(c) for c in t}
+            return len(t) >= 2 and (cls == {"kata"} or cls == {"latin"})
+
+        expanded: List[str] = []
+        for t in tokens:
+            # a compound whose dictionary-backed split was already emitted
+            # stays whole; unigramming it too would double-count every char
+            if len(t) >= 2 and _is_unknown(t) and t not in decompounded:
+                expanded.extend(t)
+            else:
+                expanded.append(t)
+        tokens = expanded
     if stopwords:
         stop = set(stopwords)
         tokens = [t for t in tokens if t not in stop]

@@ -16,15 +16,12 @@ DONE_DIR=/tmp/relay_watch_done_v2
 mkdir -p "$DONE_DIR"
 DEADLINE=$(( $(date +%s) + 4*3600 ))
 
-publish() {  # append lines from $1 to $OUT, skipping already-present metrics
-  local line metric
-  while IFS= read -r line; do
-    metric=$(printf '%s\n' "$line" | sed -n 's/.*"metric": "\([^"]*\)".*/\1/p')
-    if [ -n "$metric" ] && grep -qF "\"$metric\"" "$OUT" 2>/dev/null; then
-      continue
-    fi
-    printf '%s\n' "$line" >> "$OUT"
-  done < "$1"
+publish() {  # publish <tag> <lines-file>: keep each tag's LATEST capture and
+  # regenerate $OUT from all tags — a clean rerun replaces its own earlier
+  # partial lines, while distinct tags with identical metric names (the two
+  # bench.py variance runs) both keep their samples
+  cp "$2" "$DONE_DIR/$1.jsonl"
+  cat "$DONE_DIR"/*.jsonl > "$OUT" 2>/dev/null
 }
 
 probe() {
@@ -51,7 +48,7 @@ run_one() {  # run_one <tag> <cmd...>
   # a CPU-fallback or zero-value run must not retire the tag or publish:
   # every script embeds the jax platform in its metric name
   if grep -q '_tpu' "$tmp"; then
-    publish "$tmp"
+    publish "$tag" "$tmp"
     if [ "$rc" -eq 0 ]; then
       touch "$DONE_DIR/$tag"
       echo "[$(date +%T)] $tag done ($(wc -l < "$tmp") lines)" >&2

@@ -1,0 +1,28 @@
+"""Child program for test_launch.py: record the cluster view the launcher
+handed us, prove sys.argv passthrough, and run one tiny collective."""
+
+import json
+import sys
+
+import jax
+import jax.numpy as jnp
+
+out_path = sys.argv[1]
+extra = sys.argv[2] if len(sys.argv) > 2 else ""
+
+# one REAL cross-device collective — a psum spanning the GLOBAL device set
+# (pmap collectives are global under jax.distributed) — so a cluster that
+# joined but cannot communicate fails loudly, not silently
+total = int(jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+    jnp.ones((len(jax.local_devices()),)))[0])
+
+with open(out_path, "w") as f:
+    json.dump({
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+        "argv_extra": extra,
+        "collective": total,
+    }, f)
+print(f"LAUNCH CHILD {jax.process_index()} OK")

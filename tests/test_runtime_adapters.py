@@ -160,3 +160,28 @@ class TestAdapters:
         batches = [df.iloc[:50], df.iloc[50:100]]
         outs = list(predict_stream(model, batches))
         assert len(outs) == 2 and len(outs[0]) == 50
+
+
+class TestTokenizeJaExtended:
+    def test_extended_unigrams_unknown_words(self):
+        """EXTENDED replaces unknown (OOV) tokens with character 1-grams
+        (Kuromoji Mode.EXTENDED semantics); known dictionary words pass
+        through whole."""
+        from hivemall_tpu.nlp.tokenizer import backend_name
+
+        toks = tokenize_ja("ガラパゴスのペン", "extended")
+        if backend_name() != "lattice":
+            return  # membership heuristic differs on external backends
+        # ガラパゴス is OOV -> unigrammed; ペン is a lexicon word -> whole
+        for ch in "ガラパゴス":
+            assert ch in toks, toks
+        assert "ガラパゴス" not in toks, toks
+        assert "ペン" in toks, toks
+
+    def test_extended_differs_from_search(self):
+        text = "ガラパゴス諸島"
+        assert tokenize_ja(text, "search") != tokenize_ja(text, "extended")
+
+    def test_search_keeps_unknowns_whole(self):
+        toks = tokenize_ja("ガラパゴス", "search")
+        assert "ガラパゴス" in toks
