@@ -25,14 +25,16 @@ case $cmd in
 esac
 
 if [ -f "$WORKER_LIST" ]; then
-  # strip comments and blank lines; one host per line, list order = proc id
-  mapfile -t hosts < <(sed 's/#.*$//; /^[[:space:]]*$/d' "$WORKER_LIST")
+  # strip comments, surrounding whitespace, and blank lines; one host per
+  # line, list order = proc id
+  mapfile -t hosts < <(sed 's/#.*$//; s/^[[:space:]]*//; s/[[:space:]]*$//; /^$/d' "$WORKER_LIST")
 else
   hosts=(localhost)
 fi
 n=${#hosts[@]}
 coordinator="${hosts[0]}:$COORD_PORT"
 
+rcdir=$(mktemp -d)
 i=0
 for host in "${hosts[@]}"; do
   if [ "$cmd" = start ]; then
@@ -41,7 +43,19 @@ for host in "${hosts[@]}"; do
     remote_cmd="'$HOME_DIR/bin/hivemall_tpu_daemon.sh' $cmd"
   fi
   # shellcheck disable=SC2086  # SSH_OPTS is intentionally word-split
-  ssh $SSH_OPTS "$host" "$remote_cmd" 2>&1 | sed "s/^/$host: /" &
+  ( ssh $SSH_OPTS "$host" "$remote_cmd" 2>&1; echo "$?" > "$rcdir/$i" ) \
+    | sed "s/^/$host: /" &
   i=$((i + 1))
 done
 wait
+
+# surface per-host failures (daemon status/start exit 1 deliberately)
+overall=0
+i=0
+for host in "${hosts[@]}"; do
+  rc=$(cat "$rcdir/$i" 2>/dev/null || echo 255)
+  [ "$rc" -ne 0 ] && { echo "$host: exit $rc" >&2; overall=1; }
+  i=$((i + 1))
+done
+rm -rf "$rcdir"
+exit $overall

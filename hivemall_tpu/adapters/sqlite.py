@@ -161,7 +161,9 @@ def register(conn: sqlite3.Connection) -> sqlite3.Connection:
                 return _f(parse_features(a), parse_features(b))
         elif marshal == "text_to_features":
             fn = _wrap_features_out(fn)
-        conn.create_function(sql_name, arity, fn, deterministic=False)
+        # every registered scalar is pure -> deterministic=True lets SQLite
+        # use them in expression indexes and factor repeated calls
+        conn.create_function(sql_name, arity, fn, deterministic=True)
 
     class _F1TokenLists(F1Score):
         """F1Score.iterate takes label LISTS per row; SQL hands TEXT — split
@@ -233,11 +235,12 @@ def explode_features(conn: sqlite3.Connection, src_query: str,
     """(id, features TEXT) rows -> `(rowid, feature INTEGER, value REAL)`
     — the explode step of the reference's pure-SQL inference plan
     (SURVEY.md §3.5). String feature names are hashed like
-    feature_hashing() (ref: ftvec/hashing/FeatureHashingUDF.java:172)."""
+    feature_hashing() (ref: ftvec/hashing/FeatureHashingUDF.java:172);
+    `num_features` is REQUIRED when names are strings and must match the
+    trainer's `-dims` (same feature space as the model table)."""
     from ..utils.feature import parse_feature
-    from ..utils.hashing import DEFAULT_NUM_FEATURES, mhash
+    from ..utils.hashing import mhash
 
-    n = num_features or DEFAULT_NUM_FEATURES
     q = conn.cursor()
     q.execute(f"DROP TABLE IF EXISTS {out_table}")
     q.execute(f"CREATE TABLE {out_table} "
@@ -249,7 +252,14 @@ def explode_features(conn: sqlite3.Connection, src_query: str,
             try:
                 idx = int(name)
             except ValueError:
-                idx = mhash(name, n)
+                # hashing must land in the SAME space the model was trained
+                # at or the join silently mismatches — refuse to guess
+                if num_features is None:
+                    raise ValueError(
+                        f"feature {name!r} is a string name; pass "
+                        "num_features= matching the trainer's -dims so it "
+                        "hashes into the model's feature space")
+                idx = mhash(name, num_features)
             ins.append((rid, idx, float(value)))
     q.executemany(f"INSERT INTO {out_table} VALUES (?,?,?)", ins)
     conn.commit()

@@ -14,6 +14,11 @@ OUT=PERF_TPU_r03.jsonl
 # can never retire a benchmark under the current ones
 DONE_DIR=/tmp/relay_watch_done_v2
 mkdir -p "$DONE_DIR"
+# preserve results published by any earlier watcher version that appended
+# straight to $OUT — the regeneration below would otherwise truncate them
+if [ -f "$OUT" ] && [ ! -f "$DONE_DIR/_legacy.jsonl" ]; then
+  cp "$OUT" "$DONE_DIR/_legacy.jsonl"
+fi
 DEADLINE=$(( $(date +%s) + 4*3600 ))
 
 publish() {  # publish <tag> <lines-file>: keep each tag's LATEST capture and
