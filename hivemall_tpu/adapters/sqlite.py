@@ -241,10 +241,8 @@ def explode_features(conn: sqlite3.Connection, src_query: str,
     from ..utils.feature import parse_feature
     from ..utils.hashing import mhash
 
-    q = conn.cursor()
-    q.execute(f"DROP TABLE IF EXISTS {out_table}")
-    q.execute(f"CREATE TABLE {out_table} "
-              "(rowid INTEGER, feature INTEGER, value REAL)")
+    # build all rows BEFORE touching out_table so a refused call (or a bad
+    # src_query) leaves any existing exploded table intact
     ins = []
     for rid, text in conn.execute(src_query):
         for fv in parse_features(text):
@@ -261,5 +259,9 @@ def explode_features(conn: sqlite3.Connection, src_query: str,
                         "hashes into the model's feature space")
                 idx = mhash(name, num_features)
             ins.append((rid, idx, float(value)))
+    q = conn.cursor()
+    q.execute(f"DROP TABLE IF EXISTS {out_table}")
+    q.execute(f"CREATE TABLE {out_table} "
+              "(rowid INTEGER, feature INTEGER, value REAL)")
     q.executemany(f"INSERT INTO {out_table} VALUES (?,?,?)", ins)
     conn.commit()

@@ -31,3 +31,4 @@ from .text import (  # noqa: F401
     unbase91,
 )
 from .mapred import distcache_gets, jobconf_gets, jobid, rowid, taskid  # noqa: F401
+from .convert import kdd_expand, libsvm_rows, one_vs_rest  # noqa: F401

@@ -15,8 +15,10 @@ OUT=PERF_TPU_r03.jsonl
 DONE_DIR=/tmp/relay_watch_done_v2
 mkdir -p "$DONE_DIR"
 # preserve results published by any earlier watcher version that appended
-# straight to $OUT — the regeneration below would otherwise truncate them
-if [ -f "$OUT" ] && [ ! -f "$DONE_DIR/_legacy.jsonl" ]; then
+# straight to $OUT — the regeneration below would otherwise truncate them.
+# Only when NO per-tag captures exist: if any do, $OUT was regenerated from
+# them and snapshotting it would double every line on restart
+if [ -f "$OUT" ] && ! ls "$DONE_DIR"/*.jsonl >/dev/null 2>&1; then
   cp "$OUT" "$DONE_DIR/_legacy.jsonl"
 fi
 DEADLINE=$(( $(date +%s) + 4*3600 ))

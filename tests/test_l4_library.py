@@ -310,3 +310,38 @@ def test_tree_model_type_ids():
     assert model_type_id("javascript") == 2
     assert model_type_id("json") == 3
     assert model_type_id("opscode", compressed=True) == -1
+
+
+class TestConverters:
+    """resources/misc converter parity (conv.awk, kddconv.awk,
+    one-vs-rest.awk)."""
+
+    def test_libsvm_rows(self):
+        from hivemall_tpu.tools.convert import libsvm_rows
+
+        rows = list(libsvm_rows(["+1 1:0.5 3:1", "-1 2:2.0"]))
+        assert rows == [(1, "+1", ["1:0.5", "3:1"]), (2, "-1", ["2:2.0"])]
+
+    def test_kdd_expand(self):
+        from hivemall_tpu.tools.convert import kdd_expand
+
+        out = list(kdd_expand(["r1\t2\t1\tf:1\tg:2\n"]))
+        assert out == [("r1", 1.0, ["f:1", "g:2"])] * 2 \
+            + [("r1", 0.0, ["f:1", "g:2"])]
+
+    def test_one_vs_rest(self):
+        from hivemall_tpu.tools.convert import one_vs_rest
+
+        out = list(one_vs_rest([(["a", "b", "c"], 7, "b", "x:1")]))
+        assert out == [(7, "a", -1, "x:1"), (7, "b", 1, "x:1"),
+                       (7, "c", -1, "x:1")]
+
+    def test_cli_roundtrip(self):
+        import subprocess
+        import sys as _sys
+
+        r = subprocess.run(
+            [_sys.executable, "-m", "hivemall_tpu.tools.convert", "libsvm"],
+            input="+1 1:0.5 3:1\n", capture_output=True, text=True)
+        assert r.returncode == 0
+        assert r.stdout == "1\t+1\t1:0.5,3:1\n"

@@ -157,3 +157,35 @@ def test_sql_evaluation_of_sql_scores(conn):
         SELECT logloss(s.prob, (t.label + 1) / 2.0)
         FROM scores s JOIN train t ON t.id = s.id""").fetchone()[0]
     assert 0.0 < ll < 0.55, ll
+
+
+def test_string_features_hash_consistently_across_train_and_explode(conn):
+    """String feature names must land in the same hashed space in the
+    trainer and in explode_features, or the model join silently mismatches
+    (both route through mhash mod num_features)."""
+    rng = np.random.RandomState(0)
+    names = [f"word{i}" for i in range(50)]
+    w_true = {n: rng.randn() for n in names}
+    rows = []
+    for i in range(300):
+        picked = rng.choice(names, size=5, replace=False)
+        y = 1.0 if sum(w_true[n] for n in picked) > 0 else -1.0
+        rows.append((i, " ".join(f"{n}:1" for n in picked), y))
+    conn.execute("CREATE TABLE st (id INTEGER, features TEXT, label REAL)")
+    conn.executemany("INSERT INTO st VALUES (?,?,?)", rows)
+    hsql.train(conn, "train_arow", "SELECT features, label FROM st",
+               options="-dims 1024", model_table="stm")
+    hsql.explode_features(conn, "SELECT id, features FROM st", "stex",
+                          num_features=1024)
+    sc = conn.execute("""
+        SELECT stex.rowid, sigmoid(SUM(m.weight * stex.value))
+        FROM stex JOIN stm m ON m.feature = stex.feature
+        GROUP BY stex.rowid ORDER BY stex.rowid""").fetchall()
+    acc = np.mean([(p > 0.5) == (lab > 0)
+                   for (_, p), (_, _, lab) in zip(sc, rows)])
+    assert acc > 0.9, acc
+
+    # and without num_features, string names must refuse rather than
+    # silently hash into the wrong space
+    with pytest.raises(ValueError, match="num_features"):
+        hsql.explode_features(conn, "SELECT id, features FROM st", "stex2")
