@@ -127,13 +127,19 @@ def tokenize_ja(text: str, mode: str = "normal",
         extra: List[str] = []
         decompounded = set()
         for t in tokens:
-            if len(t) >= 4 and all(_char_class(c) == "kanji" for c in t):
+            # Kuromoji SEARCH penalizes long kanji (>=4 here) and long
+            # other-script runs (>=7) so lexicalized splits win; katakana
+            # compounds only decompound dictionary-backed (no 2-gram
+            # fallback — kana 2-grams are noise)
+            is_kanji = len(t) >= 4 and all(_char_class(c) == "kanji" for c in t)
+            is_long_kata = len(t) >= 7 and all(_char_class(c) == "kata" for c in t)
+            if is_kanji or is_long_kata:
                 parts: List[str] = []
                 if _BACKEND_NAME == "lattice":
                     parts = backend.decompound(t)
                 if parts:
                     decompounded.add(t)
-                elif mode == "search":
+                elif mode == "search" and is_kanji:
                     # recall-oriented 2-gram fallback for OOV compounds;
                     # EXTENDED skips it — its own unigram stage below covers
                     # OOV (emitting both would duplicate every character)

@@ -32,14 +32,23 @@ def libsvm_rows(lines: Iterable[str]) -> Iterator[Tuple[int, str, List[str]]]:
         yield nr, parts[0], parts[1:]
 
 
+def _int0(s: str) -> int:
+    """awk-style numeric coercion: non-numeric (e.g. a header cell) -> 0,
+    so a stray header row expands to nothing instead of aborting the run."""
+    try:
+        return int(s)
+    except ValueError:
+        return 0
+
+
 def kdd_expand(lines: Iterable[str]) -> Iterator[Tuple[str, float, List[str]]]:
     """Tab-separated (rowid, clicks, non_clicks, feat, feat, ...) ->
     one (rowid, label, features) row per impression."""
     for line in lines:
-        parts = line.rstrip("\n").split("\t")
+        parts = line.rstrip("\r\n").split("\t")
         if len(parts) < 4:
             continue
-        rowid, clicks, non_clicks = parts[0], int(parts[1]), int(parts[2])
+        rowid, clicks, non_clicks = parts[0], _int0(parts[1]), _int0(parts[2])
         features = parts[3:]
         for _ in range(clicks):
             yield rowid, 1.0, features
@@ -71,12 +80,14 @@ def _main(argv: List[str]) -> int:
         for rowid, label, feats in kdd_expand(sys.stdin):
             out.write(f"{rowid}\t{label}\t{','.join(feats)}\n")
     elif name == "one_vs_rest":
-        # input TSV: possible_labels(comma-joined) \t rowid \t label \t features
+        # input TSV: possible_labels(comma-joined) \t rowid \t label \t
+        # features... (additional tab-separated feature columns are joined,
+        # as in kdd_expand's row shape)
         def rows():
             for line in sys.stdin:
-                p = line.rstrip("\n").split("\t")
-                if len(p) == 4:
-                    yield p[0].split(","), p[1], p[2], p[3]
+                p = line.rstrip("\r\n").split("\t")
+                if len(p) >= 4:
+                    yield p[0].split(","), p[1], p[2], "\t".join(p[3:])
 
         for rowid, cand, y, feats in one_vs_rest(rows()):
             out.write(f"{rowid}\t{cand}\t{y}\t{feats}\n")

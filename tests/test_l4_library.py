@@ -345,3 +345,11 @@ class TestConverters:
             input="+1 1:0.5 3:1\n", capture_output=True, text=True)
         assert r.returncode == 0
         assert r.stdout == "1\t+1\t1:0.5,3:1\n"
+
+    def test_kdd_expand_header_and_crlf(self):
+        from hivemall_tpu.tools.convert import kdd_expand
+
+        out = list(kdd_expand(["rowid\tclicks\tnonclicks\tf\n",
+                               "r1\t1\t0\tf:1\r\n"]))
+        # header coerces to 0 expansions (awk parity); CRLF stripped
+        assert out == [("r1", 1.0, ["f:1"])]
