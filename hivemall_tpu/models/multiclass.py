@@ -241,7 +241,8 @@ def _row_quantities_sharded(weights, covars, idx, val, label, use_cov,
 
 
 def make_mc_train_step(rule: MCRule, hyper: dict, mode: str = "scan",
-                       feature_shard: Optional[Tuple[str, int]] = None):
+                       feature_shard: Optional[Tuple[str, int]] = None,
+                       jit: bool = True):
     """`feature_shard=(axis_name, stripe)` runs the same step on [L, D/S]
     table stripes inside shard_map — the multiclass analog of the engine's
     feature-sharded training (an L-label covariance model at 2^24 dims is
@@ -335,7 +336,10 @@ def make_mc_train_step(rule: MCRule, hyper: dict, mode: str = "scan",
         return state.replace(weights=weights, covars=covars, touched=touched,
                              step=state.step + b), jnp.sum(loss)
 
-    return jax.jit(scan_step if mode == "scan" else minibatch_step, donate_argnums=(0,))
+    step = scan_step if mode == "scan" else minibatch_step
+    # jit=False returns the raw traceable fn for embedding in an outer scan
+    # (e.g. a whole-epoch lax.scan over staged blocks, scripts/bench_mc.py)
+    return jax.jit(step, donate_argnums=(0,)) if jit else step
 
 
 @jax.jit

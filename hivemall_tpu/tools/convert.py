@@ -18,6 +18,7 @@ reading/writing the same TSV row shapes the reference's Hive LOAD expects.
 
 from __future__ import annotations
 
+import re
 import sys
 from typing import Iterable, Iterator, List, Sequence, Tuple
 
@@ -32,13 +33,15 @@ def libsvm_rows(lines: Iterable[str]) -> Iterator[Tuple[int, str, List[str]]]:
         yield nr, parts[0], parts[1:]
 
 
+_NUM_PREFIX = re.compile(r"^[+-]?(\d+(\.\d*)?|\.\d+)")
+
+
 def _int0(s: str) -> int:
-    """awk-style numeric coercion: non-numeric (e.g. a header cell) -> 0,
+    """awk-style numeric coercion: the leading numeric prefix, truncated
+    (int("2.0")=2, int("3abc")=3), non-numeric (e.g. a header cell) -> 0 —
     so a stray header row expands to nothing instead of aborting the run."""
-    try:
-        return int(s)
-    except ValueError:
-        return 0
+    m = _NUM_PREFIX.match(s.strip())
+    return int(float(m.group(0))) if m else 0
 
 
 def kdd_expand(lines: Iterable[str]) -> Iterator[Tuple[str, float, List[str]]]:
@@ -81,13 +84,14 @@ def _main(argv: List[str]) -> int:
             out.write(f"{rowid}\t{label}\t{','.join(feats)}\n")
     elif name == "one_vs_rest":
         # input TSV: possible_labels(comma-joined) \t rowid \t label \t
-        # features... (additional tab-separated feature columns are joined,
-        # as in kdd_expand's row shape)
+        # features... (additional tab-separated feature columns are comma-
+        # joined into ONE field, like the libsvm/kdd outputs, so the output
+        # stays a strict 4-column TSV)
         def rows():
             for line in sys.stdin:
                 p = line.rstrip("\r\n").split("\t")
                 if len(p) >= 4:
-                    yield p[0].split(","), p[1], p[2], "\t".join(p[3:])
+                    yield p[0].split(","), p[1], p[2], ",".join(p[3:])
 
         for rowid, cand, y, feats in one_vs_rest(rows()):
             out.write(f"{rowid}\t{cand}\t{y}\t{feats}\n")

@@ -69,7 +69,7 @@ run_one() {  # run_one <tag> <cmd...>
 }
 
 all_done() {
-  for t in ctr_e2e fm ffm forest arow1 arow2; do
+  for t in ctr_e2e fm ffm mc forest arow1 arow2; do
     [ -e "$DONE_DIR/$t" ] || return 1
   done
 }
@@ -81,6 +81,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       --train-rows 2097152 --test-rows 262144 --epochs-arow 4 --epochs-fm 4
     run_one fm      python -u scripts/bench_fm.py
     run_one ffm     python -u scripts/bench_ffm.py
+    run_one mc      python -u scripts/bench_mc.py
     run_one forest  python -u scripts/bench_forest.py
     run_one arow1   python -u bench.py
     run_one arow2   python -u bench.py
