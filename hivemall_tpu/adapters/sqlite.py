@@ -116,6 +116,41 @@ def _list_agg(fn: Callable, arity: int):
                 {"fn": staticmethod(fn), "arity": arity}), arity
 
 
+class _FMPredict:
+    """fm_predict(wi, vif_json, xi): grouped FM scoring over model-joined
+    feature rows — ŷ = Σ wi·xi + ½ Σ_f [(Σ vif·xi)² − Σ vif²·xi²]; the
+    bias row (feature -1: wi=w0, vif NULL, xi=1) contributes w0 through
+    the linear term (ref: fm/FMPredictGenericUDAF.java — identical
+    iterate/terminate algebra)."""
+
+    def __init__(self):
+        self.linear = 0.0
+        self.s = None  # Σ vif·xi per factor
+        self.q = None  # Σ vif²·xi² per factor
+
+    def step(self, wi, vif, xi):
+        if xi is None:
+            return
+        x = float(xi)
+        if wi is not None:
+            self.linear += float(wi) * x
+        if vif is not None:
+            v = json.loads(vif)
+            if self.s is None:
+                self.s = [0.0] * len(v)
+                self.q = [0.0] * len(v)
+            for f, vf in enumerate(v):
+                self.s[f] += vf * x
+                self.q[f] += vf * vf * x * x
+        return
+
+    def finalize(self):
+        pair = 0.0
+        if self.s is not None:
+            pair = 0.5 * sum(sf * sf - qf for sf, qf in zip(self.s, self.q))
+        return self.linear + pair
+
+
 _SCALARS = {
     # (sql_name, arity, registry_name or callable, marshal)
     "sigmoid": (1, "sigmoid", None),
@@ -184,6 +219,7 @@ def register(conn: sqlite3.Connection) -> sqlite3.Connection:
         "weight_voted_avg": _list_agg(weight_voted_avg, 1),
         "max_label": _list_agg(max_label, 2),
         "argmin_kld": _list_agg(argmin_kld, 2),
+        "fm_predict": (_FMPredict, 3),
     }.items():
         conn.create_aggregate(name, arity, cls)
     return conn
@@ -193,27 +229,10 @@ def connect(database: str = ":memory:", **kw) -> sqlite3.Connection:
     return register(sqlite3.connect(database, **kw))
 
 
-def train(conn: sqlite3.Connection, trainer: str, src_query: str,
-          options: Optional[str] = None, model_table: str = "model"):
-    """Run a registry trainer over `src_query`'s (features TEXT, label)
-    rows; materialize the model table and return the model object.
-
-    The SQL-engine flow of `INSERT ... SELECT train_arow(features, label)
-    FROM t` (ref: define-all.hive:27-28 + the UDTF emit at close,
-    BinaryOnlineClassifierUDTF.java:249-298): SQLite has no table-valued
-    UDFs, so the rewrite — pull rows, train, materialize — is explicit."""
-    fn = get_function(trainer)
-    rows = conn.execute(src_query).fetchall()
-    feats = [parse_features(r[0]) for r in rows]
-    labels = [r[1] for r in rows]
-    model = fn(feats, labels, options) if options is not None \
-        else fn(feats, labels)
-
+def _materialize_linear(q, model, model_table: str) -> None:
     from ..core.state import model_rows
 
     out = model_rows(model.state)
-    q = conn.cursor()
-    q.execute(f"DROP TABLE IF EXISTS {model_table}")
     if len(out) == 3 and out[2] is not None:
         q.execute(f"CREATE TABLE {model_table} "
                   "(feature INTEGER PRIMARY KEY, weight REAL, covar REAL)")
@@ -225,6 +244,95 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
                   "(feature INTEGER PRIMARY KEY, weight REAL)")
         q.executemany(f"INSERT INTO {model_table} VALUES (?,?)",
                       zip(map(int, out[0]), map(float, out[1])))
+
+
+def _materialize_fm(q, model, model_table: str) -> None:
+    """(feature, wi, vif JSON) rows; feature -1 carries w0 with NULL vif.
+    The reference emits w0 as feature "0" (forwardAsIntFeature,
+    FactorizationMachineUDTF.java:446-519) because its int features are
+    1-based; this feature space is 0-based (hashed ids land in [0, dims)),
+    so the bias row lives at -1 where it can never alias a real feature."""
+    w0, feats, w, v = model.model_rows()
+    q.execute(f"CREATE TABLE {model_table} "
+              "(feature INTEGER PRIMARY KEY, wi REAL, vif TEXT)")
+    q.execute(f"INSERT INTO {model_table} VALUES (-1, ?, NULL)", (float(w0),))
+    q.executemany(
+        f"INSERT INTO {model_table} VALUES (?,?,?)",
+        ((int(f), float(wi), json.dumps([float(x) for x in vi]))
+         for f, wi, vi in zip(feats, w, v)))
+
+
+def _materialize_ffm(q, model, model_table: str) -> None:
+    """FFM materializes its LINEAR part only — `(feature, wi)` + the w0
+    bias on feature -1. The field-aware V table is deliberately not
+    emitted as rows: the reference likewise ships FFM models as an opaque
+    compressed blob, not joinable rows (ref: FFMPredictionModel
+    Externalizable, fm/FFMPredictionModel.java:46-200); pairwise scoring
+    stays framework-side via the returned model object's predict()."""
+    feats, w, w0 = model.model_rows()
+    q.execute(f"CREATE TABLE {model_table} "
+              "(feature INTEGER PRIMARY KEY, wi REAL)")
+    q.execute(f"INSERT INTO {model_table} VALUES (-1, ?)", (float(w0),))
+    q.executemany(f"INSERT INTO {model_table} VALUES (?,?)",
+                  zip(map(int, feats), map(float, w)))
+
+
+def _materialize_multiclass(q, model, model_table: str) -> None:
+    """(label, feature, weight[, covar]) — the per-label close() emission
+    (ref: MulticlassOnlineClassifierUDTF close)."""
+    out = model.model_rows()
+    if len(out) == 4:
+        labels, feats, w, cov = out
+        q.execute(f"CREATE TABLE {model_table} (label TEXT, feature INTEGER, "
+                  "weight REAL, covar REAL, PRIMARY KEY (label, feature))")
+        q.executemany(f"INSERT INTO {model_table} VALUES (?,?,?,?)",
+                      zip(map(str, labels), map(int, feats),
+                          map(float, w), map(float, cov)))
+    else:
+        labels, feats, w = out
+        q.execute(f"CREATE TABLE {model_table} (label TEXT, feature INTEGER, "
+                  "weight REAL, PRIMARY KEY (label, feature))")
+        q.executemany(f"INSERT INTO {model_table} VALUES (?,?,?)",
+                      zip(map(str, labels), map(int, feats), map(float, w)))
+
+
+def train(conn: sqlite3.Connection, trainer: str, src_query: str,
+          options: Optional[str] = None, model_table: str = "model"):
+    """Run a registry trainer over `src_query`'s (features TEXT, label)
+    rows; materialize the model table and return the model object.
+
+    The SQL-engine flow of `INSERT ... SELECT train_arow(features, label)
+    FROM t` (ref: define-all.hive:27-28 + the UDTF emit at close,
+    BinaryOnlineClassifierUDTF.java:249-298): SQLite has no table-valued
+    UDFs, so the rewrite — pull rows, train, materialize — is explicit.
+
+    The table shape follows the trainer family, exactly the reference's
+    per-family emissions: linear `(feature, weight[, covar])`; FM
+    `(feature, wi, vif JSON)` with w0 on feature -1 (score in SQL with the
+    fm_predict aggregate); FFM linear part only (V stays framework-side,
+    like the reference's opaque blob); multiclass
+    `(label, feature, weight[, covar])` (score with SUM(weight*value) per
+    (row,label) + max_label)."""
+    fn = get_function(trainer)
+    rows = conn.execute(src_query).fetchall()
+    feats = [parse_features(r[0]) for r in rows]
+    labels = [r[1] for r in rows]
+    model = fn(feats, labels, options) if options is not None \
+        else fn(feats, labels)
+
+    from ..models.ffm import TrainedFFMModel
+    from ..models.fm import TrainedFMModel
+
+    q = conn.cursor()
+    q.execute(f"DROP TABLE IF EXISTS {model_table}")
+    if isinstance(model, TrainedFMModel):
+        _materialize_fm(q, model, model_table)
+    elif isinstance(model, TrainedFFMModel):
+        _materialize_ffm(q, model, model_table)
+    elif hasattr(model, "label_vocab"):  # multiclass family
+        _materialize_multiclass(q, model, model_table)
+    else:
+        _materialize_linear(q, model, model_table)
     conn.commit()
     return model
 

@@ -189,3 +189,87 @@ def test_string_features_hash_consistently_across_train_and_explode(conn):
     # silently hash into the wrong space
     with pytest.raises(ValueError, match="num_features"):
         hsql.explode_features(conn, "SELECT id, features FROM st", "stex2")
+
+
+def test_fm_model_table_and_sql_fm_predict(conn):
+    """FM materializes (feature, wi, vif JSON) with w0 on feature 0, and the
+    fm_predict aggregate scores it in pure SQL identically to the
+    framework's own predict (FMPredictGenericUDAF algebra)."""
+    rows = _make_dataset(conn)
+    model = hsql.train(conn, "train_fm",
+                       "SELECT features, label FROM train",
+                       options="-dims 32 -factors 4 -classification -iters 2",
+                       model_table="fm_model")
+    cols = [r[1] for r in conn.execute("PRAGMA table_info(fm_model)")]
+    assert cols == ["feature", "wi", "vif"]
+    w0 = conn.execute(
+        "SELECT wi FROM fm_model WHERE feature = -1").fetchone()[0]
+    assert w0 == pytest.approx(float(model.state.w0))
+
+    hsql.explode_features(conn, "SELECT id, features FROM train",
+                          out_table="fmex", num_features=32)
+    # add_bias for the w0 row (the reference's tutorials do the same; the
+    # bias slot is -1 here because our feature space is 0-based)
+    conn.execute("INSERT INTO fmex SELECT DISTINCT rowid, -1, 1.0 FROM fmex")
+    scored = conn.execute("""
+        SELECT fmex.rowid, fm_predict(m.wi, m.vif, fmex.value)
+        FROM fmex JOIN fm_model m ON m.feature = fmex.feature
+        GROUP BY fmex.rowid ORDER BY fmex.rowid""").fetchall()
+    sql_scores = np.array([s for _, s in scored])
+    fw = np.asarray(model.predict([r[1].split() for r in rows]))
+    np.testing.assert_allclose(sql_scores, fw, rtol=2e-4, atol=2e-4)
+
+
+def test_multiclass_model_table_and_sql_plan(conn):
+    """Multiclass materializes (label, feature, weight, covar) rows, and the
+    per-label SUM + max_label SQL plan reproduces the framework's argmax."""
+    rng = np.random.RandomState(4)
+    d, L = 32, 3
+    centers = rng.randn(L, d)
+    rows = []
+    for i in range(300):
+        lab = i % L
+        idx = np.argsort(-centers[lab] + 0.5 * rng.randn(d))[:5]
+        rows.append((i, " ".join(f"{j}:1" for j in idx), f"class{lab}"))
+    conn.execute("CREATE TABLE mc (id INTEGER, features TEXT, label TEXT)")
+    conn.executemany("INSERT INTO mc VALUES (?,?,?)", rows)
+    model = hsql.train(conn, "train_multiclass_arow",
+                       "SELECT features, label FROM mc",
+                       options="-dims 32", model_table="mc_model")
+    cols = [r[1] for r in conn.execute("PRAGMA table_info(mc_model)")]
+    assert cols == ["label", "feature", "weight", "covar"]
+
+    hsql.explode_features(conn, "SELECT id, features FROM mc",
+                          out_table="mcex", num_features=32)
+    got = conn.execute("""
+        WITH per_label AS (
+          SELECT mcex.rowid AS id, m.label AS label,
+                 SUM(m.weight * mcex.value) AS score
+          FROM mcex JOIN mc_model m ON m.feature = mcex.feature
+          GROUP BY mcex.rowid, m.label)
+        SELECT id, max_label(score, label) FROM per_label
+        GROUP BY id ORDER BY id""").fetchall()
+    sql_pred = [p for _, p in got]
+    fw_pred = model.predict([r[1].split() for r in rows])
+    agree = np.mean([a == b for a, b in zip(sql_pred, fw_pred)])
+    assert agree > 0.98, agree
+    acc = np.mean([p == lab for p, (_, _, lab) in zip(sql_pred, rows)])
+    assert acc > 0.85, acc
+
+
+def test_ffm_materializes_linear_part(conn):
+    """FFM model tables carry the linear part + bias only; V stays
+    framework-side (the reference ships FFM as an opaque blob)."""
+    rows = _make_dataset(conn)
+    model = hsql.train(conn, "train_ffm",
+                       "SELECT features, label FROM train",
+                       options="-feature_hashing 8 -factors 2",
+                       model_table="ffm_model")
+    cols = [r[1] for r in conn.execute("PRAGMA table_info(ffm_model)")]
+    assert cols == ["feature", "wi"]
+    w0 = conn.execute(
+        "SELECT wi FROM ffm_model WHERE feature = -1").fetchone()[0]
+    assert w0 == pytest.approx(float(model.state.w0))
+    # full pairwise scoring remains on the returned model object
+    scores = model.predict([r[1].split() for r in rows[:8]])
+    assert len(scores) == 8
