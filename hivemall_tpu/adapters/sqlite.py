@@ -297,7 +297,8 @@ def _materialize_multiclass(q, model, model_table: str) -> None:
 
 
 def train(conn: sqlite3.Connection, trainer: str, src_query: str,
-          options: Optional[str] = None, model_table: str = "model"):
+          options: Optional[str] = None, model_table: str = "model",
+          warm_start_table: Optional[str] = None):
     """Run a registry trainer over `src_query`'s (features TEXT, label)
     rows; materialize the model table and return the model object.
 
@@ -317,8 +318,55 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
     rows = conn.execute(src_query).fetchall()
     feats = [parse_features(r[0]) for r in rows]
     labels = [r[1] for r in rows]
-    model = fn(feats, labels, options) if options is not None \
-        else fn(feats, labels)
+
+    kw = {}
+    if warm_start_table is not None:
+        # `-loadmodel` with the model table living IN the engine instead of
+        # a file (ref: LearnerBaseUDTF.loadPredictionModel:215-333 reads the
+        # model table from the distributed cache). Linear trainers only —
+        # exactly the fit_linear family; FM/FFM/multiclass would silently
+        # drop (or reject) the kwargs.
+        import re as _re
+
+        from ..io.checkpoint import dense_from_rows
+        import numpy as _np
+
+        if fn.__module__.rsplit(".", 1)[-1] not in ("classifier",
+                                                    "regression"):
+            raise ValueError(
+                f"warm_start_table supports linear trainers only; "
+                f"{trainer} is not one")
+        m = _re.search(r"-(?:dims|feature_dimensions)\s+(\d+)", options or "")
+        if m is None:
+            raise ValueError(
+                "warm_start_table needs an explicit -dims in options so the "
+                "model table maps into the right feature space")
+        dims = int(m.group(1))
+        cols = [r[1] for r in conn.execute(
+            f"PRAGMA table_info({warm_start_table})")]
+        if not cols:
+            raise ValueError(f"no such table: {warm_start_table}")
+        if cols not in (["feature", "weight"],
+                        ["feature", "weight", "covar"]):
+            raise ValueError(
+                f"{warm_start_table} is not a linear model table "
+                f"(columns {cols}); warm start supports linear trainers only")
+        wrows = conn.execute(
+            f"SELECT * FROM {warm_start_table}").fetchall()
+        f0 = _np.array([r[0] for r in wrows], dtype=_np.int64)
+        if f0.size and (int(f0.max()) >= dims or int(f0.min()) < 0):
+            raise ValueError(
+                f"{warm_start_table} has feature ids outside [0, {dims}) "
+                f"(min {int(f0.min())}, max {int(f0.max())}); pass the "
+                "-dims it was trained at")
+        w0 = _np.array([r[1] for r in wrows], dtype=_np.float32)
+        c0 = _np.array([r[2] for r in wrows], dtype=_np.float32) \
+            if len(cols) > 2 else None
+        iw, ic = dense_from_rows(dims, f0, w0, c0)
+        kw = {"initial_weights": iw, "initial_covars": ic}
+
+    model = fn(feats, labels, options, **kw) if options is not None \
+        else fn(feats, labels, **kw)
 
     from ..models.ffm import TrainedFFMModel
     from ..models.fm import TrainedFMModel

@@ -273,3 +273,54 @@ def test_ffm_materializes_linear_part(conn):
     # full pairwise scoring remains on the returned model object
     scores = model.predict([r[1].split() for r in rows[:8]])
     assert len(scores) == 8
+
+
+def test_warm_start_from_model_table(conn):
+    """warm_start_table = the -loadmodel path with the model living in the
+    engine (LearnerBaseUDTF.loadPredictionModel analog)."""
+    rows = _make_dataset(conn)
+    hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+               options="-dims 32", model_table="full_model")
+
+    # continue training from the full model on a 10-row sliver: the warm
+    # state must carry the full model's accuracy
+    warm = hsql.train(conn, "train_arow",
+                      "SELECT features, label FROM train LIMIT 10",
+                      options="-dims 32", model_table="warm_model",
+                      warm_start_table="full_model")
+    feats = [r[1].split() for r in rows]
+    scores = np.asarray(warm.predict(feats))
+    acc_warm = np.mean([(s > 0) == (lab > 0)
+                        for s, (_, _, lab) in zip(scores, rows)])
+    assert acc_warm > 0.9, acc_warm
+
+    # a fresh model on the same sliver cannot know the rest of the space
+    cold = hsql.train(conn, "train_arow",
+                      "SELECT features, label FROM train LIMIT 10",
+                      options="-dims 32", model_table="cold_model")
+    s2 = np.asarray(cold.predict(feats))
+    acc_cold = np.mean([(s > 0) == (lab > 0)
+                        for s, (_, _, lab) in zip(s2, rows)])
+    assert acc_warm > acc_cold
+
+    # guard rails: -dims required; non-linear tables refused
+    with pytest.raises(ValueError, match="-dims"):
+        hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+                   warm_start_table="full_model")
+    hsql.train(conn, "train_fm", "SELECT features, label FROM train",
+               options="-dims 32", model_table="fm_m")
+    with pytest.raises(ValueError, match="linear model table"):
+        hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+                   options="-dims 32", warm_start_table="fm_m")
+    # non-linear TRAINERS refuse up front (FM would silently drop the kwargs)
+    with pytest.raises(ValueError, match="linear trainers only"):
+        hsql.train(conn, "train_fm", "SELECT features, label FROM train",
+                   options="-dims 32", warm_start_table="full_model")
+    # nonexistent table names its real problem
+    with pytest.raises(ValueError, match="no such table"):
+        hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+                   options="-dims 32", warm_start_table="full_modle")
+    # a smaller -dims than the table was trained at must refuse, not alias
+    with pytest.raises(ValueError, match="feature ids outside"):
+        hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+                   options="-dims 8", warm_start_table="full_model")
