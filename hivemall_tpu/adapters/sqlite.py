@@ -38,17 +38,26 @@ from ..evaluation.metrics import AUC, F1Score, LogLossAggregator, MAE, MSE, R2, 
 from ..sql import get_function
 
 
-def parse_features(text: Optional[str]) -> List[str]:
-    """TEXT -> the list-of-"name:value" rows every trainer consumes.
-    Accepts a JSON array string or whitespace-joined items."""
-    if text is None:
-        return []
-    s = text.strip()
-    if not s:
-        return []
-    if s.startswith("["):
-        return [str(x) for x in json.loads(s)]
-    return s.split()
+def _parse_list(cast: Callable) -> Callable:
+    def parse(text: Optional[str]) -> List:
+        if text is None:
+            return []
+        s = text.strip()
+        if not s:
+            return []
+        if s.startswith("["):
+            return [cast(x) for x in json.loads(s)]
+        return [cast(x) for x in s.split()]
+
+    return parse
+
+
+#: TEXT -> the list-of-"name:value" rows every trainer consumes
+#: (JSON array string or whitespace-joined items)
+parse_features = _parse_list(str)
+#: TEXT -> a dense numeric feature vector (the reference's array<double>
+#: forest input): JSON array or whitespace-joined floats
+parse_dense = _parse_list(float)
 
 
 def _wrap_features_in(fn: Callable) -> Callable:
@@ -116,6 +125,14 @@ def _list_agg(fn: Callable, arity: int):
                 {"fn": staticmethod(fn), "arity": arity}), arity
 
 
+def _rf_ensemble_json(votes) -> str:
+    from ..ensemble import rf_ensemble
+
+    label, prob, post = rf_ensemble(votes)
+    return json.dumps({"label": int(label), "probability": prob,
+                       "probabilities": post})
+
+
 class _FMPredict:
     """fm_predict(wi, vif_json, xi): grouped FM scoring over model-joined
     feature rows — ŷ = Σ wi·xi + ½ Σ_f [(Σ vif·xi)² − Σ vif²·xi²]; the
@@ -179,6 +196,12 @@ _SCALARS = {
     "popcnt": (1, "popcnt", None),
     "tokenize": (1, "tokenize", "text_to_features"),
     "tokenize_ja": (1, "tokenize_ja", "text_to_features"),
+    # tree_predict(model_type, pred_model, features_dense_text
+    #              [, classification]) — the reference's per-row tree
+    # evaluator (ref: TreePredictUDF.java:143-166); features are dense
+    # array<double> TEXT (JSON or space-joined); classification defaults
+    # true, pass 0 for regression forests (float leaf values)
+    "tree_predict": (-1, None, "tree_predict"),
 }
 
 
@@ -186,16 +209,30 @@ def register(conn: sqlite3.Connection) -> sqlite3.Connection:
     """Install the function library into `conn` (the define-all.hive
     analog). Returns the connection for chaining."""
     for sql_name, (arity, target, marshal) in _SCALARS.items():
-        fn = target if callable(target) else get_function(target)
-        if marshal == "features_io":
-            fn = _wrap_features_out(_wrap_features_in(fn))
-        elif marshal == "features_2in":
-            base = fn
+        if marshal == "tree_predict":
+            from functools import lru_cache
 
-            def fn(a, b, _f=base):  # noqa: E731 - bind per-iteration
-                return _f(parse_features(a), parse_features(b))
-        elif marshal == "text_to_features":
-            fn = _wrap_features_out(fn)
+            from ..models.trees.predict import compile_tree
+
+            # one compile per distinct tree, not per (row x tree): the
+            # predict flow CROSS JOINs every row against every model row
+            cached_compile = lru_cache(maxsize=4096)(compile_tree)
+
+            def fn(model_type, pred_model, features, classification=1,
+                   _c=cached_compile):
+                out = _c(model_type, pred_model)(parse_dense(features))
+                return int(out) if classification else float(out)
+        else:
+            fn = target if callable(target) else get_function(target)
+            if marshal == "features_io":
+                fn = _wrap_features_out(_wrap_features_in(fn))
+            elif marshal == "features_2in":
+                base = fn
+
+                def fn(a, b, _f=base):  # noqa: E731 - bind per-iteration
+                    return _f(parse_features(a), parse_features(b))
+            elif marshal == "text_to_features":
+                fn = _wrap_features_out(fn)
         # every registered scalar is pure -> deterministic=True lets SQLite
         # use them in expression indexes and factor repeated calls
         conn.create_function(sql_name, arity, fn, deterministic=True)
@@ -220,6 +257,9 @@ def register(conn: sqlite3.Connection) -> sqlite3.Connection:
         "max_label": _list_agg(max_label, 2),
         "argmin_kld": _list_agg(argmin_kld, 2),
         "fm_predict": (_FMPredict, 3),
+        # rf_ensemble(vote) -> JSON {label, prob, probabilities} (the
+        # reference returns a struct, ref: RandomForestEnsembleUDAF.java:34)
+        "rf_ensemble": _list_agg(_rf_ensemble_json, 1),
     }.items():
         conn.create_aggregate(name, arity, cls)
     return conn
@@ -277,6 +317,21 @@ def _materialize_ffm(q, model, model_table: str) -> None:
                   zip(map(int, feats), map(float, w)))
 
 
+def _materialize_forest(q, model, model_table: str) -> None:
+    """Per-tree rows (model_id, model_type, pred_model, var_importance JSON,
+    oob_errors, oob_tests) — the reference's forward at close
+    (ref: RandomForestClassifierUDTF.java:343-351). Score in SQL with the
+    tree_predict scalar + rf_ensemble aggregate (§3.4's predict flow)."""
+    q.execute(f"CREATE TABLE {model_table} (model_id INTEGER PRIMARY KEY, "
+              "model_type TEXT, pred_model TEXT, var_importance TEXT, "
+              "oob_errors INTEGER, oob_tests INTEGER)")
+    q.executemany(
+        f"INSERT INTO {model_table} VALUES (?,?,?,?,?,?)",
+        ((int(mid), str(mtype), model_text if isinstance(model_text, str)
+          else json.dumps(model_text), json.dumps(imp), int(oe), int(ot))
+         for mid, mtype, model_text, imp, oe, ot in model.model_rows()))
+
+
 def _materialize_multiclass(q, model, model_table: str) -> None:
     """(label, feature, weight[, covar]) — the per-label close() emission
     (ref: MulticlassOnlineClassifierUDTF close)."""
@@ -297,7 +352,8 @@ def _materialize_multiclass(q, model, model_table: str) -> None:
 
 
 def train(conn: sqlite3.Connection, trainer: str, src_query: str,
-          options: Optional[str] = None, model_table: str = "model",
+          options: Optional[str] = None,
+          model_table: Optional[str] = "model",
           warm_start_table: Optional[str] = None):
     """Run a registry trainer over `src_query`'s (features TEXT, label)
     rows; materialize the model table and return the model object.
@@ -316,7 +372,12 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
     (row,label) + max_label)."""
     fn = get_function(trainer)
     rows = conn.execute(src_query).fetchall()
-    feats = [parse_features(r[0]) for r in rows]
+    is_forest = trainer.startswith(("train_randomforest",
+                                    "train_gradient_tree"))
+    # forests consume dense array<double> rows (the reference's RF input),
+    # every other family consumes "name:value" feature lists
+    feats = [parse_dense(r[0]) if is_forest else parse_features(r[0])
+             for r in rows]
     labels = [r[1] for r in rows]
 
     kw = {}
@@ -368,19 +429,33 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
     model = fn(feats, labels, options, **kw) if options is not None \
         else fn(feats, labels, **kw)
 
+    if model_table is None:  # train-only; serve from the returned object
+        return model
+
     from ..models.ffm import TrainedFFMModel
     from ..models.fm import TrainedFMModel
+    from ..models.trees.forest import TrainedForest
 
+    # resolve the family's materializer BEFORE dropping anything so a
+    # refused call leaves any existing model table intact
+    if isinstance(model, TrainedFMModel):
+        materialize = _materialize_fm
+    elif isinstance(model, TrainedFFMModel):
+        materialize = _materialize_ffm
+    elif isinstance(model, TrainedForest):
+        materialize = _materialize_forest
+    elif hasattr(model, "label_vocab"):  # multiclass family
+        materialize = _materialize_multiclass
+    elif hasattr(model, "state") and hasattr(model.state, "weights"):
+        materialize = _materialize_linear
+    else:
+        raise ValueError(
+            f"{trainer} models have no SQL row emission (the reference "
+            "serves them framework-side too); pass model_table=None and "
+            "predict on the returned model object")
     q = conn.cursor()
     q.execute(f"DROP TABLE IF EXISTS {model_table}")
-    if isinstance(model, TrainedFMModel):
-        _materialize_fm(q, model, model_table)
-    elif isinstance(model, TrainedFFMModel):
-        _materialize_ffm(q, model, model_table)
-    elif hasattr(model, "label_vocab"):  # multiclass family
-        _materialize_multiclass(q, model, model_table)
-    else:
-        _materialize_linear(q, model, model_table)
+    materialize(q, model, model_table)
     conn.commit()
     return model
 

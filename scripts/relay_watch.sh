@@ -21,7 +21,7 @@ mkdir -p "$DONE_DIR"
 if [ -f "$OUT" ] && ! ls "$DONE_DIR"/*.jsonl >/dev/null 2>&1; then
   cp "$OUT" "$DONE_DIR/_legacy.jsonl"
 fi
-DEADLINE=$(( $(date +%s) + 4*3600 ))
+DEADLINE=$(( $(date +%s) + 9*3600 ))
 
 publish() {  # publish <tag> <lines-file>: keep each tag's LATEST capture and
   # regenerate $OUT from all tags — a clean rerun replaces its own earlier

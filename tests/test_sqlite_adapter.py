@@ -324,3 +324,89 @@ def test_warm_start_from_model_table(conn):
     with pytest.raises(ValueError, match="feature ids outside"):
         hsql.train(conn, "train_arow", "SELECT features, label FROM train",
                    options="-dims 8", warm_start_table="full_model")
+
+
+def test_forest_sql_flow(conn):
+    """The reference's forest predict flow (SURVEY.md §3.4) in SQL: RF model
+    table -> tree_predict per (row x tree) -> rf_ensemble majority vote."""
+    rng = np.random.RandomState(9)
+    X = rng.rand(300, 6)
+    y = ((X[:, 0] > 0.5) ^ (X[:, 1] > 0.5)).astype(int)
+    conn.execute("CREATE TABLE fx (id INTEGER, features TEXT, label INTEGER)")
+    conn.executemany(
+        "INSERT INTO fx VALUES (?,?,?)",
+        [(i, " ".join(f"{v:.6f}" for v in X[i]), int(y[i]))
+         for i in range(len(y))])
+
+    model = hsql.train(conn, "train_randomforest_classifier",
+                       "SELECT features, label FROM fx",
+                       options="-trees 12 -seed 31", model_table="rf_model")
+    cols = [r[1] for r in conn.execute("PRAGMA table_info(rf_model)")]
+    assert cols == ["model_id", "model_type", "pred_model",
+                    "var_importance", "oob_errors", "oob_tests"]
+
+    import json as _json
+
+    got = conn.execute("""
+        WITH votes AS (
+          SELECT fx.id AS id,
+                 tree_predict(m.model_type, m.pred_model, fx.features) AS v
+          FROM fx CROSS JOIN rf_model m)
+        SELECT id, rf_ensemble(v) FROM votes GROUP BY id ORDER BY id
+        """).fetchall()
+    sql_pred = np.array([_json.loads(r[1])["label"] for r in got])
+    fw_pred = model.predict(X)
+    np.testing.assert_array_equal(sql_pred, fw_pred)
+    assert np.mean(sql_pred == y) > 0.85
+
+    # GBT has no SQL row emission: explicit refusal + train-only mode works
+    with pytest.raises(ValueError, match="model_table=None"):
+        hsql.train(conn, "train_gradient_tree_boosting_classifier",
+                   "SELECT features, label FROM fx",
+                   options="-trees 4 -iters 3")
+    gbt = hsql.train(conn, "train_gradient_tree_boosting_classifier",
+                     "SELECT features, label FROM fx",
+                     options="-trees 4 -iters 3", model_table=None)
+    assert np.mean(gbt.predict(X) == y) > 0.8
+
+
+def test_regression_forest_sql_scoring(conn):
+    """tree_predict's optional 4th arg keeps regression leaf values float
+    (the reference's TreePredictUDF classification flag)."""
+    rng = np.random.RandomState(2)
+    X = rng.rand(200, 4)
+    y = 3.0 * X[:, 0] + X[:, 1]
+    conn.execute("CREATE TABLE rx (id INTEGER, features TEXT, target REAL)")
+    conn.executemany(
+        "INSERT INTO rx VALUES (?,?,?)",
+        [(i, " ".join(f"{v:.6f}" for v in X[i]), float(y[i]))
+         for i in range(len(y))])
+    model = hsql.train(conn, "train_randomforest_regr",
+                       "SELECT features, target FROM rx",
+                       options="-trees 8 -seed 7", model_table="rfr")
+    got = conn.execute("""
+        SELECT rx.id, AVG(tree_predict(m.model_type, m.pred_model,
+                                       rx.features, 0))
+        FROM rx CROSS JOIN rfr m GROUP BY rx.id ORDER BY rx.id""").fetchall()
+    sql_pred = np.array([p for _, p in got])
+    fw_pred = model.predict(X)
+    np.testing.assert_allclose(sql_pred, fw_pred, rtol=1e-6, atol=1e-6)
+    # float leaves, not int-truncated
+    assert np.any(np.abs(sql_pred - np.round(sql_pred)) > 1e-3)
+
+
+def test_refused_train_preserves_existing_model_table(conn):
+    """A refused materialization must not drop the caller's table."""
+    _make_dataset(conn)
+    hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+               options="-dims 32", model_table="keep_me")
+    n_before = conn.execute("SELECT COUNT(*) FROM keep_me").fetchone()[0]
+    conn.execute("CREATE TABLE fx2 (features TEXT, label INTEGER)")
+    conn.executemany("INSERT INTO fx2 VALUES (?,?)",
+                     [("0.1 0.9", 0), ("0.9 0.1", 1)] * 20)
+    with pytest.raises(ValueError, match="model_table=None"):
+        hsql.train(conn, "train_gradient_tree_boosting_classifier",
+                   "SELECT features, label FROM fx2",
+                   options="-trees 2 -iters 2", model_table="keep_me")
+    assert conn.execute("SELECT COUNT(*) FROM keep_me").fetchone()[0] \
+        == n_before
