@@ -201,7 +201,13 @@ _SCALARS = {
     # evaluator (ref: TreePredictUDF.java:143-166); features are dense
     # array<double> TEXT (JSON or space-joined); classification defaults
     # true, pass 0 for regression forests (float leaf values)
-    "tree_predict": (-1, None, "tree_predict"),
+    "tree_predict": ((3, 4), None, "tree_predict"),
+    # mf_predict(Pu, Qi[, Bu, Bi, mu]) / bprmf_predict(Pu, Qi[, Bi]) over
+    # factor vectors as TEXT (ref: MFPredictionUDF.java:33,
+    # BPRMFPredictionUDF.java); NULL factors (idx never trained) score NULL,
+    # like the reference's null-tolerant UDF
+    "mf_predict": ((2, 3, 4, 5), "mf_predict", "mf_predict"),
+    "bprmf_predict": ((2, 3), "bprmf_predict", "mf_predict"),
 }
 
 
@@ -222,6 +228,14 @@ def register(conn: sqlite3.Connection) -> sqlite3.Connection:
                    _c=cached_compile):
                 out = _c(model_type, pred_model)(parse_dense(features))
                 return int(out) if classification else float(out)
+        elif marshal == "mf_predict":
+            base_mf = get_function(target)
+
+            def fn(pu, qi, *biases, _f=base_mf):
+                if pu is None or qi is None:
+                    return None
+                return _f(parse_dense(pu), parse_dense(qi),
+                          *(0.0 if b is None else float(b) for b in biases))
         else:
             fn = target if callable(target) else get_function(target)
             if marshal == "features_io":
@@ -234,8 +248,12 @@ def register(conn: sqlite3.Connection) -> sqlite3.Connection:
             elif marshal == "text_to_features":
                 fn = _wrap_features_out(fn)
         # every registered scalar is pure -> deterministic=True lets SQLite
-        # use them in expression indexes and factor repeated calls
-        conn.create_function(sql_name, arity, fn, deterministic=True)
+        # use them in expression indexes and factor repeated calls.
+        # Multi-arity names register each fixed form (never narg=-1, which
+        # would let a stray extra SQL argument bind a wrapper's internal
+        # defaults)
+        for n in (arity if isinstance(arity, tuple) else (arity,)):
+            conn.create_function(sql_name, n, fn, deterministic=True)
 
     class _F1TokenLists(F1Score):
         """F1Score.iterate takes label LISTS per row; SQL hands TEXT — split
@@ -257,7 +275,7 @@ def register(conn: sqlite3.Connection) -> sqlite3.Connection:
         "max_label": _list_agg(max_label, 2),
         "argmin_kld": _list_agg(argmin_kld, 2),
         "fm_predict": (_FMPredict, 3),
-        # rf_ensemble(vote) -> JSON {label, prob, probabilities} (the
+        # rf_ensemble(vote) -> JSON {label, probability, probabilities} (the
         # reference returns a struct, ref: RandomForestEnsembleUDAF.java:34)
         "rf_ensemble": _list_agg(_rf_ensemble_json, 1),
     }.items():
@@ -371,9 +389,16 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
     `(label, feature, weight[, covar])` (score with SUM(weight*value) per
     (row,label) + max_label)."""
     fn = get_function(trainer)
-    rows = conn.execute(src_query).fetchall()
     is_forest = trainer.startswith(("train_randomforest",
                                     "train_gradient_tree"))
+    # fail fast BEFORE the (expensive) training run: GBT has no SQL row
+    # emission (the reference serves it framework-side too)
+    if model_table is not None and trainer.startswith("train_gradient_tree"):
+        raise ValueError(
+            f"{trainer} models have no SQL row emission (the reference "
+            "serves them framework-side too); pass model_table=None and "
+            "predict on the returned model object")
+    rows = conn.execute(src_query).fetchall()
     # forests consume dense array<double> rows (the reference's RF input),
     # every other family consumes "name:value" feature lists
     feats = [parse_dense(r[0]) if is_forest else parse_features(r[0])
@@ -456,6 +481,52 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
     q = conn.cursor()
     q.execute(f"DROP TABLE IF EXISTS {model_table}")
     materialize(q, model, model_table)
+    conn.commit()
+    return model
+
+
+def train_mf(conn: sqlite3.Connection, trainer: str, src_query: str,
+             options: Optional[str] = None,
+             model_table: Optional[str] = "mf_model"):
+    """Matrix-factorization training over `src_query`'s 3 columns —
+    (user, item, rating), or (user, pos_item, neg_item) for train_bprmf —
+    materializing the reference's per-index emission as ONE table
+    `(idx, pu TEXT, qi TEXT, bu REAL, bi REAL, mu REAL)`: user rows carry
+    pu/bu, item rows qi/bi, every row mu
+    (ref: OnlineMatrixFactorizationUDTF close/forward). Score in SQL with
+    the mf_predict / bprmf_predict scalars:
+
+        SELECT t.user, t.item, mf_predict(u.pu, i.qi, u.bu, i.bi, u.mu)
+        FROM test t
+        JOIN mf_model u ON u.idx = t.user AND u.pu IS NOT NULL
+        JOIN mf_model i ON i.idx = t.item AND i.qi IS NOT NULL
+    """
+    fn = get_function(trainer)
+    rows = conn.execute(src_query).fetchall()
+    users = [r[0] for r in rows]
+    items = [r[1] for r in rows]
+    third = [r[2] for r in rows]
+    model = fn(users, items, third, options) if options is not None \
+        else fn(users, items, third)
+    if model_table is None:
+        return model
+
+    mr = model.model_rows()
+    tu, P, Bu = mr["users"]
+    ti, Q, Bi = mr["items"]
+    mu = mr["mu"]
+    q = conn.cursor()
+    q.execute(f"DROP TABLE IF EXISTS {model_table}")
+    q.execute(f"CREATE TABLE {model_table} (idx INTEGER, pu TEXT, qi TEXT, "
+              "bu REAL, bi REAL, mu REAL)")
+    q.executemany(
+        f"INSERT INTO {model_table} VALUES (?,?,NULL,?,NULL,?)",
+        ((int(u), json.dumps([float(x) for x in pv]), float(b), mu)
+         for u, pv, b in zip(tu, P, Bu)))
+    q.executemany(
+        f"INSERT INTO {model_table} VALUES (?,NULL,?,NULL,?,?)",
+        ((int(i), json.dumps([float(x) for x in qv]), float(b), mu)
+         for i, qv, b in zip(ti, Q, Bi)))
     conn.commit()
     return model
 

@@ -410,3 +410,49 @@ def test_refused_train_preserves_existing_model_table(conn):
                    options="-trees 2 -iters 2", model_table="keep_me")
     assert conn.execute("SELECT COUNT(*) FROM keep_me").fetchone()[0] \
         == n_before
+
+
+def test_mf_model_table_and_sql_mf_predict(conn):
+    """MF materializes the reference's per-index emission in one table and
+    mf_predict scores it in SQL identically to the framework."""
+    rng = np.random.RandomState(6)
+    n_u, n_i, k = 20, 15, 3
+    P_true = rng.randn(n_u, k)
+    Q_true = rng.randn(n_i, k)
+    triples = []
+    for _ in range(600):
+        u, i = rng.randint(n_u), rng.randint(n_i)
+        triples.append((u, i, float(P_true[u] @ Q_true[i] + 3.0)))
+    conn.execute("CREATE TABLE ratings (user INTEGER, item INTEGER, r REAL)")
+    conn.executemany("INSERT INTO ratings VALUES (?,?,?)", triples)
+
+    model = hsql.train_mf(conn, "train_mf_sgd",
+                          "SELECT user, item, r FROM ratings",
+                          options="-factor 3 -iterations 20",
+                          model_table="mfm")
+    scored = conn.execute("""
+        SELECT t.user, t.item, mf_predict(u.pu, i.qi, u.bu, i.bi, u.mu)
+        FROM ratings t
+        JOIN mfm u ON u.idx = t.user AND u.pu IS NOT NULL
+        JOIN mfm i ON i.idx = t.item AND i.qi IS NOT NULL
+        LIMIT 50""").fetchall()
+    assert len(scored) == 50
+    us = [r[0] for r in scored]
+    its = [r[1] for r in scored]
+    sql_scores = np.array([r[2] for r in scored])
+    fw = model.predict(us, its)
+    np.testing.assert_allclose(sql_scores, fw, rtol=1e-5, atol=1e-5)
+    # and it learned something: fitted ratings beat predicting the mean
+    lookup = {(a, b): c for a, b, c in triples}
+    actual = np.array([lookup[(u2, i2)] for u2, i2 in zip(us, its)])
+    rmse = float(np.sqrt(np.mean((fw - actual) ** 2)))
+    base = float(np.sqrt(np.mean(
+        (actual - np.mean([t[2] for t in triples])) ** 2)))
+    assert rmse < base, (rmse, base)
+
+
+def test_mf_predict_null_factors_score_null(conn):
+    assert conn.execute("SELECT mf_predict(NULL, '[1,2]')").fetchone()[0] is None
+    got = conn.execute(
+        "SELECT bprmf_predict('[1,0]', '[0.5,2]', 0.25)").fetchone()[0]
+    assert got == pytest.approx(0.75)
