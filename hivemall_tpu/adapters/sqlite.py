@@ -501,6 +501,10 @@ def train_mf(conn: sqlite3.Connection, trainer: str, src_query: str,
         JOIN mf_model u ON u.idx = t.user AND u.pu IS NOT NULL
         JOIN mf_model i ON i.idx = t.item AND i.qi IS NOT NULL
     """
+    if trainer not in ("train_mf_sgd", "train_mf_adagrad", "train_bprmf"):
+        raise ValueError(
+            f"train_mf drives the 3-column MF trainers only; use train() "
+            f"for {trainer}")
     fn = get_function(trainer)
     rows = conn.execute(src_query).fetchall()
     users = [r[0] for r in rows]
@@ -527,6 +531,9 @@ def train_mf(conn: sqlite3.Connection, trainer: str, src_query: str,
         f"INSERT INTO {model_table} VALUES (?,NULL,?,NULL,?,?)",
         ((int(i), json.dumps([float(x) for x in qv]), float(b), mu)
          for i, qv, b in zip(ti, Q, Bi)))
+    # idx can't be PRIMARY KEY (a user and an item may share an id); the
+    # documented double self-join predict plan needs the index regardless
+    q.execute(f"CREATE INDEX {model_table}_idx ON {model_table}(idx)")
     conn.commit()
     return model
 

@@ -1,0 +1,27 @@
+"""Smoke tests keeping the fast runnable examples green (the slower CTR /
+MovieLens examples are exercised manually; these two complete in seconds)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(example: str, timeout: int = 240) -> str:
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", example)],
+        env={**os.environ, "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, capture_output=True, text=True, timeout=timeout)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_sql_session_example():
+    out = _run("sql_session.py")
+    assert "entirely through SQL" in out
+
+
+def test_lof_example():
+    out = _run("lof.py")
+    assert "outliers detected correctly" in out
