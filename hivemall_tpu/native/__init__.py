@@ -63,6 +63,14 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p,
     ]
+    try:
+        lib.hm_parse_features_batch.restype = ctypes.c_int64
+        lib.hm_parse_features_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+    except AttributeError:  # older .so without the parser
+        pass
     _lib = lib
     return lib
 
@@ -78,18 +86,25 @@ def murmur3(data: bytes, seed: int = 0x9747B28C) -> Optional[int]:
     return int(lib.hm_murmur3_x86_32(data, len(data), seed))
 
 
+def _pack_bytes(items: Sequence[bytes]):
+    """Concatenate byte strings into (ctypes buffer, int64 offsets[n+1]) —
+    the marshalling shape every bulk string entry point shares."""
+    n = len(items)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, s in enumerate(items):
+        offsets[i + 1] = offsets[i] + len(s)
+    buf = b"".join(items)
+    return ctypes.create_string_buffer(buf, len(buf) or 1), offsets
+
+
 def murmur3_bulk(strings: Sequence[bytes], num_features: int,
                  seed: int = 0x9747B28C) -> Optional[np.ndarray]:
     lib = _load()
     if lib is None:
         return None
     n = len(strings)
-    offsets = np.zeros(n + 1, dtype=np.int64)
-    for i, s in enumerate(strings):
-        offsets[i + 1] = offsets[i] + len(s)
-    buf = b"".join(strings)
+    cbuf, offsets = _pack_bytes(strings)
     out = np.empty(n, dtype=np.int64)
-    cbuf = ctypes.create_string_buffer(buf, len(buf) or 1)
     lib.hm_murmur3_bulk(
         ctypes.cast(cbuf, ctypes.c_void_p),
         offsets.ctypes.data_as(ctypes.c_void_p), n, seed, num_features,
@@ -247,3 +262,47 @@ def forest_eval(programs: Sequence[Tuple[np.ndarray, np.ndarray, np.ndarray]],
     if rc != 0:
         raise ValueError("malformed opcode program")
     return out
+
+
+def parse_features_bulk(rows: Sequence[Sequence[str]], num_features: int
+                        ) -> Optional[Tuple[List[np.ndarray], List[np.ndarray]]]:
+    """Bulk-parse rows of "name[:value]" tokens through the C parser
+    (hm_parse_features_batch): one concatenated buffer in, flat idx/val
+    arrays out, re-split per row. Returns None when the .so is absent or a
+    token falls outside the canonical grammar (caller uses the Python
+    parser, keeping error behavior and exotic-literal handling identical)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hm_parse_features_batch"):
+        return None
+    toks: List[bytes] = []
+    row_lens = np.empty(len(rows), dtype=np.int64)
+    for r, row in enumerate(rows):
+        row_lens[r] = len(row)
+        for t in row:
+            if type(t) is not str:
+                return None  # (name, value) tuples etc. -> Python path
+            if not t.isascii():
+                # the C scan can't see Unicode-NUMERIC names that Python's
+                # int() would direct-index (e.g. Arabic-Indic digits, nbsp
+                # + digits); decline those precisely — ordinary non-ASCII
+                # names (no decimals/whitespace) stay on the fast path
+                name = t.split(":", 1)[0]
+                if any(ch.isdecimal() or ch.isspace() for ch in name):
+                    return None
+            toks.append(t.encode("utf-8"))
+    n = len(toks)
+    cbuf, offsets = _pack_bytes(toks)
+    out_idx = np.empty(n, dtype=np.int64)
+    out_val = np.empty(n, dtype=np.float32)
+    rc = lib.hm_parse_features_batch(
+        ctypes.cast(cbuf, ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p), n, num_features,
+        out_idx.ctypes.data_as(ctypes.c_void_p),
+        out_val.ctypes.data_as(ctypes.c_void_p))
+    if rc != 0:
+        return None
+    bounds = np.zeros(len(rows) + 1, dtype=np.int64)
+    np.cumsum(row_lens, out=bounds[1:])
+    idx_rows = [out_idx[bounds[r]:bounds[r + 1]] for r in range(len(rows))]
+    val_rows = [out_val[bounds[r]:bounds[r + 1]] for r in range(len(rows))]
+    return idx_rows, val_rows

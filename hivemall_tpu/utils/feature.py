@@ -78,6 +78,15 @@ def parse_features_batch(
     matching the reference's dense-model int-feature path
     (ref: LearnerBaseUDTF.java:164-196 dense vs sparse model selection).
     """
+    from .. import native
+
+    # C fast path: one pass over a concatenated token buffer (parse + hash +
+    # mod in native code). Falls back below for tuple features, exotic
+    # numeric literals, or malformed tokens (identical error behavior).
+    fast = native.parse_features_bulk(rows, num_features)
+    if fast is not None:
+        return fast
+
     idx_rows: List[np.ndarray] = []
     val_rows: List[np.ndarray] = []
     # Collect string names for one vectorized hash pass.

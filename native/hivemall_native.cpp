@@ -290,6 +290,103 @@ int32_t hm_parse_int_feature(const uint8_t* s, int64_t len, int64_t* out_idx,
     return (end && *end == '\0') ? 0 : -1;
 }
 
+// Bulk "name[:value]" feature parsing — the host pipeline's front door
+// (ref: model/FeatureValue.java:74-93 split-at-first-colon grammar;
+// ftvec/hashing/FeatureHashingUDF.java:172 string-name hashing). Tokens
+// arrive as one concatenated utf-8 buffer + offsets; per token this writes
+// the hashed/modded index and the value without Python per-token overhead.
+// Numeric names (optional +/- then digits only, <=18 digits) index the
+// space directly with floor-mod (Java %-then-fixup); anything else
+// murmur-hashes (seed 0x9747b28c) then floor-mods. Returns 0, or
+// -(token+1) on the first malformed token (caller falls back to the Python
+// parser so error behavior stays identical).
+int64_t hm_parse_features_batch(const uint8_t* buf, const int64_t* offsets,
+                                int64_t n_tokens, int64_t num_features,
+                                int64_t* out_idx, float* out_val) {
+    const uint32_t seed = 0x9747b28cU;
+    for (int64_t t = 0; t < n_tokens; t++) {
+        const uint8_t* s = buf + offsets[t];
+        const int64_t len = offsets[t + 1] - offsets[t];
+        if (len <= 0) return -(t + 1);
+        // split at the FIRST ':'
+        int64_t pos = -1;
+        for (int64_t i = 0; i < len; i++) {
+            if (s[i] == ':') { pos = i; break; }
+        }
+        if (pos == 0) return -(t + 1);
+        const int64_t name_len = (pos < 0) ? len : pos;
+        // value
+        float val = 1.0f;
+        if (pos >= 0) {
+            const int64_t vlen = len - pos - 1;
+            if (vlen <= 0 || vlen >= 63) return -(t + 1);
+            char tmp[64];
+            std::memcpy(tmp, s + pos + 1, vlen);
+            tmp[vlen] = '\0';
+            // strict value grammar: plain decimal/scientific literals only.
+            // strtof accepts more than Python float() (hex floats,
+            // "nan(chars)", locale comma decimals) — decline anything
+            // outside [0-9.eE+-] so the Python parser defines semantics
+            for (int64_t i = 0; i < vlen; i++) {
+                const char c = tmp[i];
+                if (!((c >= '0' && c <= '9') || c == '.' || c == 'e' ||
+                      c == 'E' || c == '+' || c == '-')) {
+                    return -(t + 1);
+                }
+            }
+            char* end = nullptr;
+            val = std::strtof(tmp, &end);
+            if (!end || *end != '\0') return -(t + 1);
+        }
+        // name: pure optional-sign integer -> direct index, else hash
+        bool numeric = name_len > 0 && name_len <= 19;
+        int64_t start = 0;
+        bool neg = false;
+        if (numeric && (s[0] == '+' || s[0] == '-')) {
+            neg = (s[0] == '-');
+            start = 1;
+            if (name_len == 1) numeric = false;
+        }
+        int64_t iv = 0;
+        bool numeric_ish = true;  // only [0-9+-_ \t] but not strictly numeric
+        for (int64_t i = 0; i < name_len; i++) {
+            const uint8_t c = s[i];
+            if (!((c >= '0' && c <= '9') || c == '+' || c == '-' ||
+                  c == '_' || c == ' ' || c == '\t')) {
+                numeric_ish = false;
+                break;
+            }
+        }
+        if (numeric) {
+            if (name_len - start > 18) {
+                numeric = false;
+            } else {
+                for (int64_t i = start; i < name_len; i++) {
+                    if (s[i] < '0' || s[i] > '9') { numeric = false; break; }
+                    iv = iv * 10 + (s[i] - '0');
+                }
+            }
+        }
+        // " 5" / "1_0" etc: Python's int() would accept these where the
+        // strict scan above does not — decline to the Python parser rather
+        // than silently hashing what Python would index
+        if (!numeric && numeric_ish) return -(t + 1);
+        int64_t idx;
+        if (numeric) {
+            if (neg) iv = -iv;
+            idx = iv % num_features;
+            if (idx < 0) idx += num_features;  // floor-mod, Java fixup
+        } else {
+            int64_t h = hm_murmur3_x86_32(s, name_len, seed);
+            idx = h % num_features;
+            if (idx < 0) idx += num_features;
+        }
+        out_idx[t] = idx;
+        out_val[t] = val;
+    }
+    return 0;
+}
+
 // --------------------------------------------------------- forest evaluator
 
 // Bulk StackMachine evaluation: T compiled opcode programs (the tree export

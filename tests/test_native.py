@@ -219,3 +219,62 @@ def test_forest_eval_rejects_malformed():
     argf = _np.zeros(1, _np.float64)
     with pytest.raises(ValueError):
         native.forest_eval([(ops, argi, argf)], _np.zeros((2, 2)))
+
+
+class TestParseFeaturesBulk:
+    def test_parity_with_python_parser(self):
+        import hivemall_tpu.native as native
+        from hivemall_tpu.utils.feature import parse_features_batch
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        rng = np.random.RandomState(3)
+        rows = []
+        for i in range(500):
+            row = []
+            for k in range(10):
+                r = rng.randint(4)
+                if r == 0:
+                    row.append(f"word{rng.randint(100)}:1")
+                elif r == 1:
+                    row.append(str(rng.randint(1 << 22)))
+                elif r == 2:
+                    row.append(f"{rng.randint(1 << 22)}:{rng.rand():.4f}")
+                else:
+                    row.append(f"-{rng.randint(100)}:2.5")  # negative ids
+            rows.append(row)
+        fast = native.parse_features_bulk(rows, 1 << 22)
+        assert fast is not None
+        real = native.parse_features_bulk
+        try:
+            native.parse_features_bulk = lambda *a: None  # force Python path
+            py = parse_features_batch(rows, 1 << 22)
+        finally:
+            native.parse_features_bulk = real
+        for a, b in zip(fast[0], py[0]):
+            np.testing.assert_array_equal(a, b)
+        for a, b in zip(fast[1], py[1]):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_malformed_token_falls_back(self):
+        import hivemall_tpu.native as native
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        # ':v' has an empty name; the bulk parser must decline (None), so
+        # the Python parser raises its canonical error instead
+        assert native.parse_features_bulk([[":5"]], 64) is None
+        # tuple features -> Python path
+        assert native.parse_features_bulk([[(3, 1.0)]], 64) is None
+
+    def test_utf8_names_hash_like_mhash(self):
+        import hivemall_tpu.native as native
+        from hivemall_tpu.utils.hashing import mhash
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        out = native.parse_features_bulk([["日本語:2.0", "ペン"]], 1 << 20)
+        assert out is not None
+        np.testing.assert_array_equal(
+            out[0][0], [mhash("日本語", 1 << 20), mhash("ペン", 1 << 20)])
+        np.testing.assert_allclose(out[1][0], [2.0, 1.0])
