@@ -15,3 +15,7 @@
 #HIVEMALL_TPU_PYTHON=python
 #HIVEMALL_TPU_LOG_DIR=
 #HIVEMALL_TPU_KEEP_LOGS=5
+
+# Per-worker HTTP scrape endpoint (the reference's JMX MBean analog):
+# GET /metrics (prometheus text), GET /healthz. 0 = ephemeral port.
+#HIVEMALL_TPU_METRICS_PORT=9010

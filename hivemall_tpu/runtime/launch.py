@@ -57,6 +57,19 @@ def main(argv=None) -> int:
         host, port = parse_mix_option(args.mix)
         coordinator = f"{host}:{port}"
 
+    # JMX-analog scrape endpoint (runtime/metrics_http.py): workers started
+    # by bin/hivemall_tpu_daemon.sh opt in via env
+    mport = os.environ.get("HIVEMALL_TPU_METRICS_PORT")
+    if mport:
+        from hivemall_tpu.runtime.metrics_http import serve_metrics
+
+        # cluster workers must be reachable by a remote scraper by default
+        # (the JMX analog is remote too); override with _METRICS_HOST
+        mhost = os.environ.get("HIVEMALL_TPU_METRICS_HOST", "0.0.0.0")
+        srv = serve_metrics(int(mport), host=mhost)
+        print(f"[launch] metrics on {mhost}:{srv.server_address[1]}/metrics",
+              file=sys.stderr, flush=True)
+
     joined = init_cluster(coordinator, args.num_procs, args.proc_id)
     import jax
 

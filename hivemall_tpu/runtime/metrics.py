@@ -79,27 +79,35 @@ class MetricsRegistry:
         self.counters: Dict[str, Counter] = {}
         self.throughput: Dict[str, ThroughputCounter] = {}
         self.gauges: Dict[str, float] = {}
+        # registration and snapshot share one lock: the HTTP scrape thread
+        # (runtime/metrics_http.py) iterates while the training thread may
+        # be registering new keys
+        self._lock = threading.Lock()
 
     def counter(self, group: str, name: str) -> Counter:
         key = f"{group}.{name}"
-        if key not in self.counters:
-            self.counters[key] = Counter(group, name)
-        return self.counters[key]
+        with self._lock:
+            if key not in self.counters:
+                self.counters[key] = Counter(group, name)
+            return self.counters[key]
 
     def meter(self, name: str) -> ThroughputCounter:
-        if name not in self.throughput:
-            self.throughput[name] = ThroughputCounter()
-        return self.throughput[name]
+        with self._lock:
+            if name not in self.throughput:
+                self.throughput[name] = ThroughputCounter()
+            return self.throughput[name]
 
     def set_gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = value
+        with self._lock:
+            self.gauges[name] = value
 
     def snapshot(self) -> Dict[str, float]:
-        out: Dict[str, float] = dict(self.gauges)
-        for key, c in self.counters.items():
-            out[key] = float(c.value)
-        for name, t in self.throughput.items():
-            out[f"{name}.per_sec"] = t.last_reads_per_sec
+        with self._lock:
+            out: Dict[str, float] = dict(self.gauges)
+            for key, c in self.counters.items():
+                out[key] = float(c.value)
+            for name, t in self.throughput.items():
+                out[f"{name}.per_sec"] = t.last_reads_per_sec
         return out
 
 

@@ -1,0 +1,84 @@
+"""HTTP metrics endpoint — the JMX MBean surface, reachable the modern way.
+
+The reference exposes its MIX server metrics over JMX
+(ref: mixserv/.../metrics/MetricsRegistry.java registers
+MixServerMetricsMBean per port; ThroughputCounter feeds it msgs/sec every
+5s, MixServer.java:144-149). A JVM-less runtime exposes the same registry
+as an HTTP scrape endpoint instead:
+
+- `GET /metrics`  — Prometheus text exposition of the process-wide
+  `runtime.metrics.REGISTRY` snapshot (counters, gauges, meters);
+- `GET /healthz`  — liveness (200 + json with process/device info).
+
+`serve_metrics(port)` starts a daemon thread (stdlib only); every worker
+started by bin/hivemall_tpu_daemon.sh can enable it with
+HIVEMALL_TPU_METRICS_PORT.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .metrics import REGISTRY
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(key: str) -> str:
+    """Metric keys like "train.rows_processed" -> prometheus-legal names."""
+    return _NAME_OK.sub("_", key.replace(".", "_"))
+
+
+def render_prometheus(snapshot: Optional[dict] = None) -> str:
+    snap = REGISTRY.snapshot() if snapshot is None else snapshot
+    lines = []
+    for key in sorted(snap):
+        lines.append(f"hivemall_tpu_{_prom_name(key)} {float(snap[key])}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path.split("?")[0] == "/metrics":
+            body = render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path.split("?")[0] == "/healthz":
+            info = {"status": "ok"}
+            try:
+                import jax
+
+                info["process_index"] = jax.process_index()
+                info["process_count"] = jax.process_count()
+                info["local_devices"] = len(jax.local_devices())
+            except Exception:  # jax not initialized yet — still alive
+                pass
+            body = json.dumps(info).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+            self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr noise
+        pass
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1"
+                  ) -> ThreadingHTTPServer:
+    """Start the scrape endpoint on a daemon thread; returns the server
+    (``server.server_address[1]`` is the bound port — pass port=0 for an
+    ephemeral one). Call ``server.shutdown()`` to stop."""
+    server = ThreadingHTTPServer((host, port), _Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="hivemall-tpu-metrics")
+    t.start()
+    return server

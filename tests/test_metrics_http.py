@@ -1,0 +1,44 @@
+"""HTTP metrics endpoint tests (runtime/metrics_http.py) — the JMX MBean
+surface analog (ref: mixserv/.../metrics/MetricsRegistry.java,
+ThroughputCounter feeding msgs/sec into the MBean)."""
+
+import json
+import urllib.request
+
+from hivemall_tpu.runtime.metrics import REGISTRY
+from hivemall_tpu.runtime.metrics_http import render_prometheus, serve_metrics
+
+
+def test_render_prometheus_names_and_values():
+    text = render_prometheus({"train.rows_processed": 42.0,
+                              "mix.psum.per_sec": 1.5,
+                              "weird key-#1": 2.0})
+    lines = dict(l.rsplit(" ", 1) for l in text.strip().splitlines())
+    assert lines["hivemall_tpu_train_rows_processed"] == "42.0"
+    assert lines["hivemall_tpu_mix_psum_per_sec"] == "1.5"
+    assert lines["hivemall_tpu_weird_key__1"] == "2.0"
+
+
+def test_live_scrape_and_health():
+    REGISTRY.counter("test_http", "hits").increment(7)
+    REGISTRY.set_gauge("test_http.gauge", 2.5)
+    server = serve_metrics(port=0)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "hivemall_tpu_test_http_hits 7.0" in body
+        assert "hivemall_tpu_test_http_gauge 2.5" in body
+
+        health = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+        assert health["status"] == "ok"
+
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+            assert False, "404 expected"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.shutdown()
