@@ -33,7 +33,8 @@ import json
 import sqlite3
 from typing import Callable, List, Optional, Sequence
 
-from ..ensemble import argmin_kld, max_label, voted_avg, weight_voted_avg
+from ..ensemble import (argmin_kld, max_label, rf_ensemble, voted_avg,
+                        weight_voted_avg)
 from ..evaluation.metrics import AUC, F1Score, LogLossAggregator, MAE, MSE, R2, RMSE
 from ..sql import get_function
 
@@ -126,8 +127,6 @@ def _list_agg(fn: Callable, arity: int):
 
 
 def _rf_ensemble_json(votes) -> str:
-    from ..ensemble import rf_ensemble
-
     label, prob, post = rf_ensemble(votes)
     return json.dumps({"label": int(label), "probability": prob,
                        "probabilities": post})
@@ -412,17 +411,18 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
         # model table from the distributed cache). Linear trainers only —
         # exactly the fit_linear family; FM/FFM/multiclass would silently
         # drop (or reject) the kwargs.
-        import re as _re
+        import re
+
+        import numpy as np
 
         from ..io.checkpoint import dense_from_rows
-        import numpy as _np
 
         if fn.__module__.rsplit(".", 1)[-1] not in ("classifier",
                                                     "regression"):
             raise ValueError(
                 f"warm_start_table supports linear trainers only; "
                 f"{trainer} is not one")
-        m = _re.search(r"-(?:dims|feature_dimensions)\s+(\d+)", options or "")
+        m = re.search(r"-(?:dims|feature_dimensions)\s+(\d+)", options or "")
         if m is None:
             raise ValueError(
                 "warm_start_table needs an explicit -dims in options so the "
@@ -439,14 +439,14 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
                 f"(columns {cols}); warm start supports linear trainers only")
         wrows = conn.execute(
             f"SELECT * FROM {warm_start_table}").fetchall()
-        f0 = _np.array([r[0] for r in wrows], dtype=_np.int64)
+        f0 = np.array([r[0] for r in wrows], dtype=np.int64)
         if f0.size and (int(f0.max()) >= dims or int(f0.min()) < 0):
             raise ValueError(
                 f"{warm_start_table} has feature ids outside [0, {dims}) "
                 f"(min {int(f0.min())}, max {int(f0.max())}); pass the "
                 "-dims it was trained at")
-        w0 = _np.array([r[1] for r in wrows], dtype=_np.float32)
-        c0 = _np.array([r[2] for r in wrows], dtype=_np.float32) \
+        w0 = np.array([r[1] for r in wrows], dtype=np.float32)
+        c0 = np.array([r[2] for r in wrows], dtype=np.float32) \
             if len(cols) > 2 else None
         iw, ic = dense_from_rows(dims, f0, w0, c0)
         kw = {"initial_weights": iw, "initial_covars": ic}
