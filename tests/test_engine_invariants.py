@@ -95,3 +95,39 @@ def test_make_epoch_equals_step_loop():
     np.testing.assert_allclose(np.asarray(losses), np.asarray(loop_losses),
                                rtol=1e-5, atol=1e-6)
     assert int(st_e.step) == int(st_s.step)
+
+
+def test_make_epoch_fm_family():
+    """make_epoch composes with the FM step's jit=False form identically to
+    the per-block jitted loop (the bench_ctr_e2e/bench_fm deployment path)."""
+    import jax.numpy as jnp
+
+    from hivemall_tpu.core.engine import make_epoch
+    from hivemall_tpu.models.fm import FMHyper, init_fm_state, make_fm_step
+
+    d, n_blocks, b, k = 32, 4, 8, 3
+    rng = np.random.RandomState(5)
+    idx = rng.randint(0, d, size=(n_blocks, b, k)).astype(np.int32)
+    val = rng.rand(n_blocks, b, k).astype(np.float32)
+    y = np.sign(rng.randn(n_blocks, b)).astype(np.float32)
+    va = jnp.zeros((b,), jnp.float32)
+
+    hyper = FMHyper(factors=3, classification=True)
+    fn = make_fm_step(hyper, mode="minibatch", jit=False)
+    epoch = make_epoch(lambda s, bi, bv, bl: fn(s, bi, bv, bl, va),
+                       donate=False)
+    st_e = init_fm_state(d, hyper)
+    st_e, _ = epoch(st_e, idx, val, y)
+
+    step = make_fm_step(hyper, mode="minibatch")
+    st_s = init_fm_state(d, hyper)
+    for i in range(n_blocks):
+        st_s, _ = step(st_s, idx[i], val[i], y[i], va)
+
+    np.testing.assert_allclose(np.asarray(st_e.w), np.asarray(st_s.w),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(st_e.v), np.asarray(st_s.v),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(float(st_e.w0), float(st_s.w0),
+                               rtol=1e-6, atol=1e-7)
+    assert int(st_e.step) == int(st_s.step)
