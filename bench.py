@@ -126,12 +126,36 @@ def _run_child(env_overrides: dict, timeout: float):
     return None
 
 
+def _probe_tpu(timeout: float = 75.0) -> bool:
+    """Cheap child probe: is the axon relay serving? A dead relay hangs
+    backend init, so a full measurement attempt against it wastes its whole
+    timeout — probe first and skip straight to CPU when it's down."""
+    code = ("import jax; import sys; "
+            "sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, timeout=timeout,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+        return proc.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def main() -> None:
-    # TPU attempt with the env as launched, one retry (transient relay
-    # hiccups), then CPU with the relay scrubbed so backend init cannot hang.
-    result = _run_child({}, timeout=360)
-    if result is None:
-        result = _run_child({}, timeout=240)
+    # Probe, then TPU attempt with the env as launched, one retry (transient
+    # relay hiccups), then CPU with the relay scrubbed so backend init
+    # cannot hang.
+    # probe twice (transient relay hiccups get a second chance; a healthy
+    # probe returns in ~15s, far below its 75s kill timeout) — only a
+    # twice-dead relay skips the TPU attempts
+    result = None
+    if _probe_tpu() or _probe_tpu():
+        result = _run_child({}, timeout=360)
+        if result is None:
+            result = _run_child({}, timeout=240)
+    else:
+        print("bench: TPU relay probe failed twice; falling back to CPU",
+              file=sys.stderr)
     if result is None:
         from hivemall_tpu.relay_env import SCRUB_ENV
 
