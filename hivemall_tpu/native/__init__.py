@@ -64,6 +64,18 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p,
     ]
     try:
+        lib.hm_lattice_tokenize_bulk.restype = ctypes.c_int64
+        lib.hm_lattice_tokenize_bulk.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+    except AttributeError:  # older .so without the tokenizer
+        pass
+    try:
         lib.hm_parse_features_batch.restype = ctypes.c_int64
         lib.hm_parse_features_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
@@ -306,3 +318,36 @@ def parse_features_bulk(rows: Sequence[Sequence[str]], num_features: int
     idx_rows = [out_idx[bounds[r]:bounds[r + 1]] for r in range(len(rows))]
     val_rows = [out_val[bounds[r]:bounds[r + 1]] for r in range(len(rows))]
     return idx_rows, val_rows
+
+
+def lattice_tokenize_bulk(cps: np.ndarray, classes: np.ndarray,
+                          text_offsets: np.ndarray,
+                          surf_buf: np.ndarray, surf_offsets: np.ndarray,
+                          entry_offsets: np.ndarray, entry_pos: np.ndarray,
+                          entry_cost: np.ndarray, max_word: int,
+                          conn: np.ndarray,
+                          unk_base: np.ndarray, unk_per: np.ndarray,
+                          unk_pos: np.ndarray):
+    """Bulk lattice Viterbi (hm_lattice_tokenize_bulk); all marshalling is
+    done by the caller (nlp/lattice.py, which owns the lexicon encoding).
+    Returns (starts, lens, pos_ids, counts) or None when unavailable."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hm_lattice_tokenize_bulk"):
+        return None
+    n_texts = len(text_offsets) - 1
+    total_chars = int(text_offsets[-1])
+    out_start = np.empty(max(total_chars, 1), np.int32)
+    out_len = np.empty(max(total_chars, 1), np.int32)
+    out_pos = np.empty(max(total_chars, 1), np.int16)
+    out_counts = np.empty(max(n_texts, 1), np.int64)
+    as_p = lambda a: a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
+    rc = lib.hm_lattice_tokenize_bulk(
+        as_p(cps), as_p(classes), as_p(text_offsets), n_texts,
+        as_p(surf_buf), as_p(surf_offsets), as_p(entry_offsets),
+        as_p(entry_pos), as_p(entry_cost), len(surf_offsets) - 1,
+        int(max_word), as_p(conn), conn.shape[0],
+        as_p(unk_base), as_p(unk_per), as_p(unk_pos),
+        as_p(out_start), as_p(out_len), as_p(out_pos), as_p(out_counts))
+    if rc < 0:
+        return None
+    return out_start[:rc], out_len[:rc], out_pos[:rc], out_counts

@@ -1,1 +1,1 @@
-from .tokenizer import tokenize_ja  # noqa: F401
+from .tokenizer import tokenize_ja, tokenize_ja_bulk  # noqa: F401

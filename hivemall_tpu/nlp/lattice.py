@@ -21,7 +21,13 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from .lexicon_ja import AUX, ADJ, ADV, CONJ, N, P, PRE, V, build_lexicon
+
+# class ids shared with the native kernel (hm_lattice_tokenize_bulk)
+_CLASS_IDS = {"hira": 0, "kata": 1, "kanji": 2, "num": 3, "latin": 4,
+              "space": 5, "punct": 6}
 
 _UNK_KANJI = "名詞"      # unknown kanji run -> noun (IPADic unk model)
 _UNK_KATA = "名詞"       # katakana run -> noun (loanword)
@@ -79,6 +85,40 @@ _UNK_POS = {"kanji": _UNK_KANJI, "kata": _UNK_KATA, "hira": _UNK_HIRA,
             "latin": _UNK_LATIN, "num": _UNK_NUM}
 
 
+def _class_array(cps: np.ndarray, texts: List[str]) -> np.ndarray:
+    """Per-codepoint class ids for the native kernel. The common ranges
+    resolve vectorized; anything else falls back to _char_class per char so
+    Python's unicode isspace/isdigit/isalnum semantics remain authoritative
+    (full-width digits, exotic scripts, odd whitespace)."""
+    cls = np.full(cps.shape, 255, np.uint8)
+    cp = cps.astype(np.uint32)
+    cls[(cp >= 0x3040) & (cp <= 0x309F)] = 0  # hira
+    cls[(cp >= 0x30A0) & (cp <= 0x30FF)] = 1  # kata (incl. 30FC)
+    cls[((cp >= 0x4E00) & (cp <= 0x9FFF)) |
+        ((cp >= 0x3400) & (cp <= 0x4DBF))] = 2  # kanji
+    # ASCII
+    cls[(cp >= 0x30) & (cp <= 0x39)] = 3
+    cls[((cp >= 0x41) & (cp <= 0x5A)) | ((cp >= 0x61) & (cp <= 0x7A))] = 4
+    cls[((cp >= 0x09) & (cp <= 0x0D)) | ((cp >= 0x1C) & (cp <= 0x1F)) |
+        (cp == 0x20)] = 5
+    ascii_rest = (cp < 0x80) & (cls == 255)
+    cls[ascii_rest] = 6
+    # the common CJK marks only — parts of the 0x3000 block are alnum in
+    # Python (〇 numeric letter, 〆), so anything else resolves below
+    cls[(cp == 0x3001) | (cp == 0x3002) |  # 、 。
+        ((cp >= 0x3008) & (cp <= 0x3011)) |  # 〈〉《》「」『』【】
+        (cp == 0x3014) | (cp == 0x3015)] = 6
+    cls[cp == 0x3000] = 5  # ideographic space
+    cls[cp == 0x3005] = 2  # 々
+    # everything else: exact Python classification, char by char (rare)
+    unresolved = np.nonzero(cls == 255)[0]
+    if len(unresolved):
+        flat = "".join(texts)
+        for i in unresolved:
+            cls[i] = _CLASS_IDS[_char_class(flat[i])]
+    return cls
+
+
 class LatticeTokenizer:
     """Viterbi over dictionary + unknown-word lattice. Returns
     (surface, pos) pairs; punctuation/space are path breaks (the Lucene
@@ -87,6 +127,108 @@ class LatticeTokenizer:
     def __init__(self, lexicon: Optional[Dict[str, List[Tuple[str, int]]]] = None):
         self.lexicon = lexicon if lexicon is not None else build_lexicon()
         self.max_word = max(len(s) for s in self.lexicon)
+        self._native_tables = None  # built lazily by tokenize_bulk
+
+    def _build_native_tables(self):
+        """Marshal the lexicon / connection costs / unknown model into the
+        flat arrays hm_lattice_tokenize_bulk consumes (codepoint surfaces,
+        per-surface entry ranges in INSERTION order so candidate iteration —
+        and therefore Viterbi tie-breaking — matches _viterbi exactly)."""
+        pos_set = {p for entries in self.lexicon.values() for p, _ in entries}
+        pos_set |= set(_UNK_POS.values())
+        pos_list = sorted(pos_set)
+        pos_id = {p: i for i, p in enumerate(pos_list)}
+
+        surf_cps: List[np.ndarray] = []
+        surf_offsets = [0]
+        entry_offsets = [0]
+        e_pos: List[int] = []
+        e_cost: List[int] = []
+        for surf, entries in self.lexicon.items():
+            if not entries:
+                # a surface with no entries yields no dictionary candidate,
+                # so it must not suppress unknown candidates in the C kernel
+                # (which keys suppression on map membership)
+                continue
+            cp = np.frombuffer(surf.encode("utf-32-le"), dtype=np.uint32)
+            surf_cps.append(cp)
+            surf_offsets.append(surf_offsets[-1] + len(cp))
+            for p, c in entries:
+                e_pos.append(pos_id[p])
+                e_cost.append(int(c))
+            entry_offsets.append(len(e_pos))
+
+        n_pos = len(pos_list)
+        conn = np.zeros((n_pos, n_pos), np.int32)
+        for (a, b), c in _CONN.items():
+            if a in pos_id and b in pos_id:
+                conn[pos_id[a], pos_id[b]] = c
+        unk_base = np.zeros(5, np.int32)
+        unk_per = np.zeros(5, np.int32)
+        unk_pos = np.zeros(5, np.int16)
+        for name, cid in _CLASS_IDS.items():
+            if cid >= 5:
+                continue
+            b, p = _UNK_COST[name]
+            unk_base[cid], unk_per[cid] = b, p
+            unk_pos[cid] = pos_id[_UNK_POS[name]]
+        self._native_tables = {
+            "pos_list": pos_list,
+            "surf_buf": np.ascontiguousarray(
+                np.concatenate(surf_cps) if surf_cps else
+                np.zeros(0, np.uint32)),
+            "surf_offsets": np.asarray(surf_offsets, np.int64),
+            "entry_offsets": np.asarray(entry_offsets, np.int64),
+            "entry_pos": np.asarray(e_pos, np.int16),
+            "entry_cost": np.asarray(e_cost, np.int32),
+            "conn": conn, "unk_base": unk_base, "unk_per": unk_per,
+            "unk_pos": unk_pos,
+        }
+        return self._native_tables
+
+    def tokenize_bulk(self, texts: List[str]) -> List[List[Tuple[str, str]]]:
+        """Tokenize many texts; uses the native Viterbi when the library is
+        built (parity-tested against tokenize(), which stays the semantic
+        authority), else loops the Python path."""
+        from .. import native
+
+        out = None
+        if texts and native.available():
+            out = self._tokenize_bulk_native(texts)
+        if out is None:
+            return [self.tokenize(t) for t in texts]
+        return out
+
+    def _tokenize_bulk_native(self, texts: List[str]):
+        from .. import native
+
+        tabs = self._native_tables or self._build_native_tables()
+        cps_list = [np.frombuffer(t.encode("utf-32-le"), dtype=np.uint32)
+                    for t in texts]
+        text_offsets = np.zeros(len(texts) + 1, np.int64)
+        for i, c in enumerate(cps_list):
+            text_offsets[i + 1] = text_offsets[i] + len(c)
+        cps = np.ascontiguousarray(
+            np.concatenate(cps_list) if cps_list else np.zeros(0, np.uint32))
+        classes = _class_array(cps, texts)
+        res = native.lattice_tokenize_bulk(
+            cps, classes, text_offsets, tabs["surf_buf"],
+            tabs["surf_offsets"], tabs["entry_offsets"], tabs["entry_pos"],
+            tabs["entry_cost"], self.max_word, tabs["conn"],
+            tabs["unk_base"], tabs["unk_per"], tabs["unk_pos"])
+        if res is None:
+            return None
+        starts, lens, pos_ids, counts = res
+        pos_list = tabs["pos_list"]
+        out: List[List[Tuple[str, str]]] = []
+        k = 0
+        for i, text in enumerate(texts):
+            n = int(counts[i])
+            toks = [(text[starts[j]:starts[j] + lens[j]],
+                     pos_list[pos_ids[j]]) for j in range(k, k + n)]
+            out.append(toks)
+            k += n
+        return out
 
     def tokenize(self, text: str) -> List[Tuple[str, str]]:
         out: List[Tuple[str, str]] = []

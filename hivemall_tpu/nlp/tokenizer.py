@@ -83,6 +83,32 @@ def backend_name() -> str:
     return _BACKEND_NAME
 
 
+def tokenize_ja_bulk(texts: Sequence[str], mode: str = "normal",
+                     stopwords: Optional[Sequence[str]] = None,
+                     stoptags: Optional[Sequence[str]] = None
+                     ) -> List[List[str]]:
+    """Corpus-shaped tokenize_ja: one call over many documents. With the
+    built-in lattice backend and NORMAL mode, segmentation runs through the
+    native bulk Viterbi (nlp/lattice.py::tokenize_bulk — parity-tested
+    against the per-text path); SEARCH/EXTENDED and external backends fall
+    back to per-text tokenize_ja. Feeds tf/feature_hashing pipelines
+    (the KuromojiUDF-over-a-corpus usage)."""
+    mode_l = (mode or "normal").lower()
+    backend = _resolve_backend()
+    if _BACKEND_NAME != "lattice" or mode_l != "normal":
+        return [tokenize_ja(t, mode, stopwords, stoptags) for t in texts]
+    normalized = [unicodedata.normalize("NFKC", t or "") for t in texts]
+    stop_top = {t for t in (stoptags or ()) if "-" not in t}
+    stop = set(stopwords or ())
+    out: List[List[str]] = []
+    for pairs in backend.tokenize_bulk(normalized):
+        toks = [s for s, pos in pairs if pos not in stop_top]
+        if stop:
+            toks = [t for t in toks if t not in stop]
+        out.append(toks)
+    return out
+
+
 def tokenize_ja(text: str, mode: str = "normal",
                 stopwords: Optional[Sequence[str]] = None,
                 stoptags: Optional[Sequence[str]] = None) -> List[str]:

@@ -13,6 +13,8 @@
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
+#include <unordered_map>
+#include <utility>
 #include <vector>
 
 extern "C" {
@@ -385,6 +387,193 @@ int64_t hm_parse_features_batch(const uint8_t* buf, const int64_t* offsets,
         out_val[t] = val;
     }
     return 0;
+}
+
+// ------------------------------------------------------ lattice tokenizer
+
+// Bulk Viterbi segmentation for the Japanese lattice tokenizer — the C
+// twin of hivemall_tpu/nlp/lattice.py::LatticeTokenizer._viterbi (which
+// remains the semantic authority; the Python wrapper parity-tests and
+// falls back). The reference's analyzer is JVM-native Kuromoji
+// (ref: nlp/.../KuromojiUDF.java:55-86); this is its host-native analog.
+//
+// Inputs are CODEPOINT arrays with per-char CLASS ids precomputed by
+// Python (so unicode isspace/isdigit/isalnum semantics never diverge):
+//   classes: 0=hira 1=kata 2=kanji 3=num 4=latin 5=space 6=punct
+// The lexicon arrives as codepoint surfaces + per-surface (pos, cost)
+// entry lists; candidate iteration order matches the Python exactly
+// (dictionary lengths ascending with entries in stored order, then
+// unknown lengths ascending, strict < updates) so ties break identically.
+namespace lattice {
+
+struct SurfKey {
+    const uint32_t* p;
+    int32_t len;
+    bool operator==(const SurfKey& o) const {
+        if (len != o.len) return false;
+        return std::memcmp(p, o.p, len * 4) == 0;
+    }
+};
+
+struct SurfHash {
+    size_t operator()(const SurfKey& k) const {
+        uint64_t h = 1469598103934665603ULL;
+        for (int32_t i = 0; i < k.len; i++) {
+            h ^= k.p[i];
+            h *= 1099511628211ULL;
+        }
+        return (size_t)h;
+    }
+};
+
+}  // namespace lattice
+
+int64_t hm_lattice_tokenize_bulk(
+    const uint32_t* cps, const uint8_t* classes, const int64_t* text_offsets,
+    int64_t n_texts,
+    // lexicon: surfaces as codepoints + per-surface entry ranges
+    const uint32_t* surf_buf, const int64_t* surf_offsets,
+    const int64_t* entry_offsets, const int16_t* entry_pos,
+    const int32_t* entry_cost, int64_t n_surfaces, int32_t max_word,
+    // connection matrix [n_pos, n_pos] and unknown model per class id 0..4
+    const int32_t* conn, int32_t n_pos,
+    const int32_t* unk_base, const int32_t* unk_per, const int16_t* unk_pos,
+    // outputs: per-token (start char, length, pos id) + per-text counts
+    int32_t* out_start, int32_t* out_len, int16_t* out_pos,
+    int64_t* out_counts) {
+    using lattice::SurfKey;
+    using lattice::SurfHash;
+
+    std::unordered_map<SurfKey, std::pair<int64_t, int64_t>, SurfHash> lex;
+    lex.reserve((size_t)n_surfaces * 2);
+    for (int64_t s = 0; s < n_surfaces; s++) {
+        SurfKey k{surf_buf + surf_offsets[s],
+                  (int32_t)(surf_offsets[s + 1] - surf_offsets[s])};
+        lex.emplace(k, std::make_pair(entry_offsets[s], entry_offsets[s + 1]));
+    }
+
+    const int64_t INF = (int64_t)1 << 60;
+    int64_t out_n = 0;
+
+    // scratch (sized to the longest segment lazily)
+    std::vector<int64_t> best_cost;
+    std::vector<int32_t> best_prev, best_len;
+    std::vector<int16_t> best_pos;
+    std::vector<int32_t> tok_start_rev, tok_len_rev;
+    std::vector<int16_t> tok_pos_rev;
+
+    for (int64_t t = 0; t < n_texts; t++) {
+        const int64_t t0 = text_offsets[t], t1 = text_offsets[t + 1];
+        int64_t count = 0;
+        int64_t i = t0;
+        while (i < t1) {
+            if (classes[i] >= 5) {  // space/punct: segment break
+                i++;
+                continue;
+            }
+            int64_t j = i;
+            while (j < t1 && classes[j] < 5) j++;
+            // Viterbi over segment [i, j)
+            const int64_t n = j - i;
+            const uint32_t* s = cps + i;
+            const uint8_t* cls = classes + i;
+            best_cost.assign(n + 1, INF);
+            best_prev.assign(n + 1, -1);
+            best_len.assign(n + 1, 0);
+            best_pos.assign(n + 1, -1);
+            best_cost[0] = 0;
+            best_pos[0] = -1;  // BOS
+            for (int64_t p = 0; p < n; p++) {
+                if (best_cost[p] >= INF) continue;
+                const int64_t c0 = best_cost[p];
+                const int16_t pos_i = best_pos[p];
+                // dictionary candidates, lengths ascending, entry order
+                const int64_t maxL = std::min<int64_t>(max_word, n - p);
+                for (int64_t L = 1; L <= maxL; L++) {
+                    SurfKey k{s + p, (int32_t)L};
+                    auto it = lex.find(k);
+                    if (it == lex.end()) continue;
+                    for (int64_t e = it->second.first; e < it->second.second;
+                         e++) {
+                        const int16_t pos = entry_pos[e];
+                        const int64_t connc =
+                            (pos_i < 0) ? 0 : conn[pos_i * n_pos + pos];
+                        const int64_t total = c0 + entry_cost[e] + connc;
+                        if (total < best_cost[p + L]) {
+                            best_cost[p + L] = total;
+                            best_prev[p + L] = (int32_t)p;
+                            best_len[p + L] = (int32_t)L;
+                            best_pos[p + L] = pos;
+                        }
+                    }
+                }
+                // unknown candidates over the same-class run
+                const uint8_t c = cls[p];
+                int64_t run = 1;
+                while (p + run < n && cls[p + run] == c) run++;
+                int64_t lens[8];
+                int64_t n_lens = 0;
+                if (c == 1 || c == 3 || c == 4) {  // kata/num/latin
+                    lens[n_lens++] = run;
+                } else if (c == 2) {  // kanji: 1..min(run,4) (+run if >4)
+                    const int64_t top = std::min<int64_t>(run, 4);
+                    for (int64_t L = 1; L <= top; L++) lens[n_lens++] = L;
+                    if (run > 4) lens[n_lens++] = run;
+                } else {  // hira: 1..min(run,3)
+                    const int64_t top = std::min<int64_t>(run, 3);
+                    for (int64_t L = 1; L <= top; L++) lens[n_lens++] = L;
+                }
+                const int64_t ub = unk_base[c], up = unk_per[c];
+                const int16_t upos = unk_pos[c];
+                for (int64_t li = 0; li < n_lens; li++) {
+                    const int64_t L = lens[li];
+                    // skip if the lexicon already covers this surface
+                    SurfKey k{s + p, (int32_t)L};
+                    if (L <= max_word && lex.find(k) != lex.end()) continue;
+                    const int64_t connc =
+                        (pos_i < 0) ? 0 : conn[pos_i * n_pos + upos];
+                    const int64_t total = c0 + ub + up * L + connc;
+                    if (total < best_cost[p + L]) {
+                        best_cost[p + L] = total;
+                        best_prev[p + L] = (int32_t)p;
+                        best_len[p + L] = (int32_t)L;
+                        best_pos[p + L] = upos;
+                    }
+                }
+            }
+            // backtrack (or the whole-segment fallback the Python has)
+            tok_start_rev.clear();
+            tok_len_rev.clear();
+            tok_pos_rev.clear();
+            if (best_prev[n] < 0 && n > 0) {
+                // unreachable end: emit the segment whole as its first
+                // char's unknown pos (lattice.py's fallback)
+                tok_start_rev.push_back((int32_t)(i - t0));
+                tok_len_rev.push_back((int32_t)n);
+                tok_pos_rev.push_back(unk_pos[cls[0]]);
+            } else {
+                int64_t pcur = n;
+                while (pcur > 0) {
+                    const int32_t prev = best_prev[pcur];
+                    if (prev < 0) return -1;  // corrupt lattice
+                    tok_start_rev.push_back((int32_t)(i - t0 + prev));
+                    tok_len_rev.push_back(best_len[pcur]);
+                    tok_pos_rev.push_back(best_pos[pcur]);
+                    pcur = prev;
+                }
+            }
+            for (int64_t r = (int64_t)tok_start_rev.size() - 1; r >= 0; r--) {
+                out_start[out_n] = tok_start_rev[r];
+                out_len[out_n] = tok_len_rev[r];
+                out_pos[out_n] = tok_pos_rev[r];
+                out_n++;
+                count++;
+            }
+            i = j;
+        }
+        out_counts[t] = count;
+    }
+    return out_n;
 }
 
 // --------------------------------------------------------- forest evaluator

@@ -5,7 +5,7 @@ import time
 import numpy as np
 import pytest
 
-from hivemall_tpu.nlp import tokenize_ja
+from hivemall_tpu.nlp import tokenize_ja, tokenize_ja_bulk
 from hivemall_tpu.runtime import (Counter, MetricsRegistry, StopWatch,
                                   ThroughputCounter)
 from hivemall_tpu.runtime.cluster import parse_mix_option
@@ -185,3 +185,58 @@ class TestTokenizeJaExtended:
     def test_search_keeps_unknowns_whole(self):
         toks = tokenize_ja("ガラパゴス", "search")
         assert "ガラパゴス" in toks
+
+
+class TestNativeLatticeBulk:
+    def test_bulk_parity_with_per_text(self):
+        """Native bulk Viterbi must segment EXACTLY like the Python lattice
+        (same candidate order -> same tie-breaks); randomized corpus."""
+        import random
+
+        from hivemall_tpu.nlp.lattice import LatticeTokenizer
+        from hivemall_tpu.nlp.lexicon_ja import build_lexicon
+
+        rng = random.Random(7)
+        words = list(build_lexicon())
+        kanji = [chr(c) for c in range(0x4E00, 0x4E40)]
+        kata = [chr(c) for c in range(0x30A1, 0x30E0)]
+
+        def text():
+            parts = []
+            for _ in range(rng.randint(1, 15)):
+                r = rng.random()
+                if r < 0.5:
+                    parts.append(rng.choice(words))
+                elif r < 0.7:
+                    parts.append("".join(rng.choice(kanji)
+                                         for _ in range(rng.randint(1, 6))))
+                elif r < 0.85:
+                    parts.append("".join(rng.choice(kata)
+                                         for _ in range(rng.randint(1, 7))))
+                else:
+                    parts.append(rng.choice(["、", "。", " ", "12", "ab"]))
+            return "".join(parts)
+
+        texts = [text() for _ in range(200)]
+        lt = LatticeTokenizer()
+        # call the native path directly so a missing .so/symbol registers
+        # as a SKIP, never as a vacuous Python-vs-Python pass
+        bulk = lt._tokenize_bulk_native(texts)
+        if bulk is None:
+            import pytest
+
+            pytest.skip("native lattice kernel unavailable")
+        per = [lt.tokenize(t) for t in texts]
+        assert bulk == per
+
+    def test_tokenize_ja_bulk_matches_per_text(self):
+        texts = ["これはペンです", "東京で寿司を食べた。", "",
+                 "機械学習のテキスト分類"]
+        bulk = tokenize_ja_bulk(texts, stoptags=["助詞"])
+        per = [tokenize_ja(t, stoptags=["助詞"]) for t in texts]
+        assert bulk == per
+
+    def test_tokenize_ja_bulk_other_modes_fall_back(self):
+        texts = ["東京特許許可局"]
+        assert tokenize_ja_bulk(texts, "search") == \
+            [tokenize_ja(texts[0], "search")]
