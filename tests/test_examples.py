@@ -1,5 +1,5 @@
 """Smoke tests keeping the fast runnable examples green (the slower CTR /
-MovieLens examples are exercised manually; these two complete in seconds)."""
+MovieLens examples are exercised manually; these complete in seconds)."""
 
 import os
 import subprocess
@@ -25,3 +25,8 @@ def test_sql_session_example():
 def test_lof_example():
     out = _run("lof.py")
     assert "outliers detected correctly" in out
+
+
+def test_text_classification_ja_example():
+    out = _run("text_classification_ja.py")
+    assert "tokenize_ja_bulk -> tf -> feature_hashing" in out
