@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import json
 import sqlite3
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional
 
 from ..ensemble import (argmin_kld, max_label, rf_ensemble, voted_avg,
                         weight_voted_avg)

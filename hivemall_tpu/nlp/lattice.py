@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .lexicon_ja import AUX, ADJ, ADV, CONJ, N, P, PRE, V, build_lexicon
+from .lexicon_ja import AUX, ADJ, N, P, PRE, V, build_lexicon
 
 # class ids shared with the native kernel (hm_lattice_tokenize_bulk)
 _CLASS_IDS = {"hira": 0, "kata": 1, "kanji": 2, "num": 3, "latin": 4,

@@ -15,7 +15,6 @@ library function for callers that want raw script-run splitting.
 
 from __future__ import annotations
 
-import re
 import unicodedata
 from typing import List, Optional, Sequence
 

@@ -3,8 +3,6 @@ workflows running inside an actual SQL engine: function registration
 (define-all.hive analog), UDAF lifecycle, trainer materialization, and the
 pure-SQL join+groupby inference plan (SURVEY.md §3.5)."""
 
-import math
-
 import numpy as np
 import pytest
 
