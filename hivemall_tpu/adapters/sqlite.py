@@ -30,6 +30,7 @@ way; parse_features accepts both).
 from __future__ import annotations
 
 import json
+import re
 import sqlite3
 from typing import Callable, List, Optional
 
@@ -37,6 +38,18 @@ from ..ensemble import (argmin_kld, max_label, rf_ensemble, voted_avg,
                         weight_voted_avg)
 from ..evaluation.metrics import AUC, F1Score, LogLossAggregator, MAE, MSE, R2, RMSE
 from ..sql import get_function
+
+
+_IDENT = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def _check_ident(name: str) -> str:
+    """Table names are interpolated into DDL/DML (sqlite has no placeholder
+    for identifiers) — accept plain identifiers only so a malformed or
+    hostile name fails loudly instead of becoming SQL."""
+    if not _IDENT.match(name or ""):
+        raise ValueError(f"not a plain SQL identifier: {name!r}")
+    return name
 
 
 def _parse_list(cast: Callable) -> Callable:
@@ -387,6 +400,10 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
     like the reference's opaque blob); multiclass
     `(label, feature, weight[, covar])` (score with SUM(weight*value) per
     (row,label) + max_label)."""
+    if model_table is not None:
+        _check_ident(model_table)
+    if warm_start_table is not None:
+        _check_ident(warm_start_table)
     fn = get_function(trainer)
     is_forest = trainer.startswith(("train_randomforest",
                                     "train_gradient_tree"))
@@ -411,8 +428,6 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
         # model table from the distributed cache). Linear trainers only —
         # exactly the fit_linear family; FM/FFM/multiclass would silently
         # drop (or reject) the kwargs.
-        import re
-
         import numpy as np
 
         from ..io.checkpoint import dense_from_rows
@@ -501,6 +516,8 @@ def train_mf(conn: sqlite3.Connection, trainer: str, src_query: str,
         JOIN mf_model u ON u.idx = t.user AND u.pu IS NOT NULL
         JOIN mf_model i ON i.idx = t.item AND i.qi IS NOT NULL
     """
+    if model_table is not None:
+        _check_ident(model_table)
     if trainer not in ("train_mf_sgd", "train_mf_adagrad", "train_bprmf"):
         raise ValueError(
             f"train_mf drives the 3-column MF trainers only; use train() "
@@ -550,6 +567,7 @@ def explode_features(conn: sqlite3.Connection, src_query: str,
     from ..utils.feature import parse_feature
     from ..utils.hashing import mhash
 
+    _check_ident(out_table)
     # build all rows BEFORE touching out_table so a refused call (or a bad
     # src_query) leaves any existing exploded table intact
     ins = []

@@ -454,3 +454,15 @@ def test_mf_predict_null_factors_score_null(conn):
     got = conn.execute(
         "SELECT bprmf_predict('[1,0]', '[0.5,2]', 0.25)").fetchone()[0]
     assert got == pytest.approx(0.75)
+
+
+def test_table_names_must_be_identifiers(conn):
+    _make_dataset(conn)
+    with pytest.raises(ValueError, match="identifier"):
+        hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+                   options="-dims 32", model_table="m; DROP TABLE train")
+    with pytest.raises(ValueError, match="identifier"):
+        hsql.explode_features(conn, "SELECT id, features FROM train",
+                              out_table="ex ex", num_features=32)
+    # the injection never ran
+    assert conn.execute("SELECT COUNT(*) FROM train").fetchone()[0] > 0
