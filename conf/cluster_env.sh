@@ -18,4 +18,9 @@
 
 # Per-worker HTTP scrape endpoint (the reference's JMX MBean analog):
 # GET /metrics (prometheus text), GET /healthz. 0 = ephemeral port.
+# SECURITY: the endpoint is unauthenticated and reveals process/device
+# info. It binds 127.0.0.1 by default; a remote scraper needs an explicit
+# HIVEMALL_TPU_METRICS_HOST=0.0.0.0 (or the scrape interface's address)
+# opt-in below — only widen it on a trusted network.
 #HIVEMALL_TPU_METRICS_PORT=9010
+#HIVEMALL_TPU_METRICS_HOST=127.0.0.1

@@ -1,0 +1,349 @@
+"""Hive `TRANSFORM ... USING` streaming bridge — a JVM-free execution path a
+real Hive cluster can drive today.
+
+Hive's streaming contract (the same one `scoreKDD.py`-style scripts use):
+the query planner pipes each map task's rows to the child process as
+TSV — columns joined by ``\\t``, rows by ``\\n``, ``\\N`` for NULL, array
+elements joined by ``\\x02`` (Hive's default collection-items terminator) —
+and parses the child's stdout with the same framing. That makes every
+registry trainer reachable from HiveQL without a JVM UDF (ref: the UDTF
+surface `hivemall/UDTFWithOptions.java:48` + `define-all.hive:27-28`; this
+bridge replaces the UDTF *transport*, not the trainer semantics):
+
+    ADD FILE hivemall-tpu;                    -- bin/hivemall-tpu shim
+    SELECT TRANSFORM (features, label)
+        USING 'hivemall-tpu train_arow -dims 16777216'
+        AS (feature INT, weight FLOAT, covar FLOAT)
+    FROM training;
+
+Each map task trains one replica over its split and emits model rows at
+close — exactly the reference's mapper-side UDTF life cycle
+(BinaryOnlineClassifierUDTF.java:249-298); the usual ensemble UDAF / GROUP
+BY `avg(weight)` / argmin_kld reduce step merges replicas, unchanged.
+
+Subcommands (one per trainer family, mirroring adapters/sqlite.py's
+materializations):
+
+- every linear binary classifier / regressor  -> ``feature weight [covar]``
+- multiclass trainers                          -> ``label feature weight [covar]``
+- ``train_fm``        -> ``feature wi vif_json`` (w0 on feature -1, NULL vif)
+- ``train_randomforest_*`` -> ``model_id model_type pred_model
+  var_importance oob_errors oob_tests`` (dense ``\\x02``-joined features in)
+- MF family (3 input columns)                  -> ``idx pu qi bu bi mu``
+- ``predict_linear -loadmodel <file> [-sigmoid]``  (rowid, features) ->
+  (rowid, score); the model file is the trainer's own TSV output shipped via
+  ``ADD FILE`` — the `-loadmodel` distributed-cache path
+  (LearnerBaseUDTF.java:215-333) without a JVM
+- ``predict_fm -loadmodel <file>``                 (rowid, features) ->
+  (rowid, score) over a train_fm TSV model
+
+Run as ``hivemall-tpu <subcommand> ...`` (bin/ shim) or
+``python -m hivemall_tpu.adapters.hive_transform <subcommand> ...``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import IO, List, Optional, Sequence
+
+HIVE_NULL = r"\N"
+ITEM_SEP = "\x02"  # Hive's default collection-items terminator
+
+
+# ------------------------------------------------------------------ framing
+
+def _fmt(v) -> str:
+    if v is None:
+        return HIVE_NULL
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def _emit(out: IO[str], *cols) -> None:
+    out.write("\t".join(_fmt(c) for c in cols))
+    out.write("\n")
+
+
+def _cells(line: str) -> List[Optional[str]]:
+    line = line.rstrip("\n")
+    return [None if c == HIVE_NULL else c for c in line.split("\t")]
+
+
+def _feature_list(cell: str) -> List[str]:
+    """A Hive array<string> arrives \\x02-joined; a plain string feature
+    column is space- (or comma-) joined — accept all three."""
+    if ITEM_SEP in cell:
+        return [t for t in cell.split(ITEM_SEP) if t]
+    if "," in cell and " " not in cell.strip():
+        return [t for t in cell.split(",") if t]
+    return cell.split()
+
+
+def _dense_list(cell: str) -> List[float]:
+    return [float(t) for t in _feature_list(cell)]
+
+
+# ------------------------------------------------------------------ training
+
+_MF_TRAINERS = frozenset(
+    ("train_mf_sgd", "train_mf_adagrad", "train_bprmf"))
+
+
+def _run_trainer(trainer: str, options: Optional[str], src: IO[str],
+                 out: IO[str]) -> int:
+    from ..sql.registry import get_function
+
+    fn = get_function(trainer)
+    is_forest = trainer.startswith(("train_randomforest",
+                                    "train_gradient_tree"))
+    if trainer.startswith("train_gradient_tree"):
+        print(f"{trainer}: GBT models have no row emission (the reference "
+              "serves them framework-side too); train through the framework "
+              "API instead", file=sys.stderr)
+        return 2
+
+    if trainer in _MF_TRAINERS:
+        return _run_mf_trainer(trainer, fn, options, src, out)
+
+    feats: list = []
+    labels: list = []
+    for line in src:
+        if not line.strip():
+            continue
+        cols = _cells(line)
+        if len(cols) < 2 or cols[0] is None or cols[-1] is None:
+            continue  # NULL feature/label rows are skipped, like the UDTF
+        feats.append(_dense_list(cols[0]) if is_forest
+                     else _feature_list(cols[0]))
+        # multiclass labels stay strings; everything else is numeric
+        labels.append(cols[-1] if trainer.startswith("train_multiclass")
+                      else float(cols[-1]))
+
+    model = fn(feats, labels, options) if options is not None \
+        else fn(feats, labels)
+    _emit_model_rows(trainer, model, out)
+    return 0
+
+
+def _run_mf_trainer(trainer: str, fn, options: Optional[str], src: IO[str],
+                    out: IO[str]) -> int:
+    """3-column input (user, item, rating) — or (user, pos_item, neg_item)
+    for train_bprmf; emission mirrors adapters/sqlite.train_mf's one-table
+    shape (ref: OnlineMatrixFactorizationUDTF close)."""
+    users: List[int] = []
+    items: List[int] = []
+    third: List[float] = []
+    for line in src:
+        if not line.strip():
+            continue
+        cols = _cells(line)
+        if len(cols) < 3 or None in cols[:3]:
+            continue
+        users.append(int(cols[0]))
+        items.append(int(cols[1]))
+        third.append(float(cols[2]))
+    if trainer == "train_bprmf":
+        model = fn(users, items, [int(t) for t in third], options) \
+            if options is not None else fn(users, items,
+                                           [int(t) for t in third])
+    else:
+        model = fn(users, items, third, options) if options is not None \
+            else fn(users, items, third)
+
+    rows = model.model_rows()
+    tu, P, Bu = rows["users"]
+    ti, Q, Bi = rows["items"]
+    mu = rows["mu"]
+    for u, pv, b in zip(tu, P, Bu):
+        _emit(out, int(u), json.dumps([float(x) for x in pv]), None,
+              float(b), None, mu)
+    for i, qv, b in zip(ti, Q, Bi):
+        _emit(out, int(i), None, json.dumps([float(x) for x in qv]),
+              None, float(b), mu)
+    return 0
+
+
+def _emit_model_rows(trainer: str, model, out: IO[str]) -> None:
+    from ..models.ffm import TrainedFFMModel
+    from ..models.fm import TrainedFMModel
+    from ..models.trees.forest import TrainedForest
+
+    if isinstance(model, TrainedFMModel):
+        w0, feats, w, v = model.model_rows()
+        _emit(out, -1, float(w0), None)
+        for f, wi, vi in zip(feats, w, v):
+            _emit(out, int(f), float(wi),
+                  json.dumps([float(x) for x in vi]))
+    elif isinstance(model, TrainedFFMModel):
+        # linear part + w0 on -1; V stays framework-side like the
+        # reference's opaque blob (fm/FFMPredictionModel.java:46-200)
+        feats, w, w0 = model.model_rows()
+        _emit(out, -1, float(w0))
+        for f, wi in zip(feats, w):
+            _emit(out, int(f), float(wi))
+    elif isinstance(model, TrainedForest):
+        for mid, mtype, text, imp, oe, ot in model.model_rows():
+            _emit(out, int(mid), str(mtype),
+                  text if isinstance(text, str) else json.dumps(text),
+                  json.dumps(imp), int(oe), int(ot))
+    elif hasattr(model, "label_vocab"):  # multiclass family
+        rows = model.model_rows()
+        for tup in zip(*rows):
+            _emit(out, *tup)
+    elif hasattr(model, "state") and hasattr(model.state, "weights"):
+        from ..core.state import model_rows
+
+        rows = model_rows(model.state)
+        if len(rows) == 3 and rows[2] is not None:
+            for f, w, c in zip(*rows):
+                _emit(out, int(f), float(w), float(c))
+        else:
+            for f, w in zip(rows[0], rows[1]):
+                _emit(out, int(f), float(w))
+    else:
+        raise ValueError(f"{trainer}: model has no row emission")
+
+
+# ---------------------------------------------------------------- predicting
+
+def _parse_predict_args(argv: Sequence[str], flags: Sequence[str] = ()):
+    """Tiny arg scan: -loadmodel <file> plus boolean flags."""
+    model_path = None
+    seen = set()
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-loadmodel", "--loadmodel"):
+            i += 1
+            if i >= len(argv):
+                raise SystemExit("-loadmodel needs a file argument")
+            model_path = argv[i]
+        elif a.lstrip("-") in flags:
+            seen.add(a.lstrip("-"))
+        else:
+            raise SystemExit(f"unknown predict option: {a}")
+        i += 1
+    if model_path is None:
+        raise SystemExit("predict requires -loadmodel <model.tsv> "
+                         "(ship it with ADD FILE)")
+    return model_path, seen
+
+
+def _run_predict_linear(argv: Sequence[str], src: IO[str],
+                        out: IO[str]) -> int:
+    import math
+
+    model_path, flags = _parse_predict_args(argv, flags=("sigmoid",))
+    weights = {}
+    with open(model_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            cols = _cells(line)
+            weights[int(cols[0])] = float(cols[1])  # covar column ignored
+
+    from ..utils.feature import parse_feature
+
+    use_sigmoid = "sigmoid" in flags
+    for line in src:
+        if not line.strip():
+            continue
+        cols = _cells(line)
+        if len(cols) < 2 or cols[1] is None:
+            continue
+        score = 0.0
+        for tok in _feature_list(cols[1]):
+            name, value = parse_feature(tok)
+            try:
+                k = int(name)
+            except ValueError:
+                print(f"predict_linear: string feature {name!r} — hash "
+                      "features before training/scoring", file=sys.stderr)
+                return 2
+            score += weights.get(k, 0.0) * value
+        if use_sigmoid:
+            score = 1.0 / (1.0 + math.exp(-score))
+        _emit(out, cols[0], score)
+    return 0
+
+
+def _run_predict_fm(argv: Sequence[str], src: IO[str], out: IO[str]) -> int:
+    model_path, _ = _parse_predict_args(argv)
+    w = {}
+    V = {}
+    w0 = 0.0
+    with open(model_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            cols = _cells(line)
+            fid = int(cols[0])
+            if fid == -1:
+                w0 = float(cols[1])
+                continue
+            w[fid] = float(cols[1])
+            if len(cols) > 2 and cols[2] is not None:
+                V[fid] = json.loads(cols[2])
+
+    from ..utils.feature import parse_feature
+
+    k = len(next(iter(V.values()))) if V else 0
+    for line in src:
+        if not line.strip():
+            continue
+        cols = _cells(line)
+        if len(cols) < 2 or cols[1] is None:
+            continue
+        try:
+            fv = [(int(n), x) for n, x in
+                  (parse_feature(t) for t in _feature_list(cols[1]))]
+        except ValueError:
+            print("predict_fm: string feature name — hash features before "
+                  "training/scoring", file=sys.stderr)
+            return 2
+        p = w0
+        for name, x in fv:
+            p += w.get(name, 0.0) * x
+        for f in range(k):
+            s = s2 = 0.0
+            for name, x in fv:
+                vf = V.get(name)
+                if vf is None:
+                    continue
+                vx = vf[f] * x
+                s += vx
+                s2 += vx * vx
+            p += 0.5 * (s * s - s2)
+        _emit(out, cols[0], p)
+    return 0
+
+
+# ----------------------------------------------------------------------- CLI
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "-help"):
+        print(__doc__)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    src, out = sys.stdin, sys.stdout
+    if cmd == "predict_linear":
+        return _run_predict_linear(rest, src, out)
+    if cmd == "predict_fm":
+        return _run_predict_fm(rest, src, out)
+
+    from ..sql.registry import REGISTRY
+
+    is_trainer = cmd.startswith("train_") or cmd == "logress"
+    if cmd not in REGISTRY or not is_trainer:
+        print(f"unknown subcommand {cmd!r}; expected a train_* registry "
+              "name, predict_linear, or predict_fm", file=sys.stderr)
+        return 2
+    options = " ".join(rest) if rest else None
+    return _run_trainer(cmd, options, src, out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,7 +212,8 @@ _SCALARS = {
     #              [, classification]) — the reference's per-row tree
     # evaluator (ref: TreePredictUDF.java:143-166); features are dense
     # array<double> TEXT (JSON or space-joined); classification defaults
-    # true, pass 0 for regression forests (float leaf values)
+    # false like the reference (TreePredictUDF.java:104) — pass 1 for
+    # classification forests (int labels)
     "tree_predict": ((3, 4), None, "tree_predict"),
     # mf_predict(Pu, Qi[, Bu, Bi, mu]) / bprmf_predict(Pu, Qi[, Bi]) over
     # factor vectors as TEXT (ref: MFPredictionUDF.java:33,
@@ -220,6 +221,11 @@ _SCALARS = {
     # like the reference's null-tolerant UDF
     "mf_predict": ((2, 3, 4, 5), "mf_predict", "mf_predict"),
     "bprmf_predict": ((2, 3), "bprmf_predict", "mf_predict"),
+    # ffm_predict(model_blob, features_text) — decodes the compressed
+    # one-row blob (cached per distinct blob) and scores the FULL pairwise
+    # model, the reference's FFMPredictUDF flow (fm/FFMPredictUDF.java over
+    # FFMPredictionModel.java:46-200)
+    "ffm_predict": (2, None, "ffm_predict"),
 }
 
 
@@ -236,10 +242,24 @@ def register(conn: sqlite3.Connection) -> sqlite3.Connection:
             # predict flow CROSS JOINs every row against every model row
             cached_compile = lru_cache(maxsize=4096)(compile_tree)
 
-            def fn(model_type, pred_model, features, classification=1,
+            def fn(model_type, pred_model, features, classification=0,
                    _c=cached_compile):
                 out = _c(model_type, pred_model)(parse_dense(features))
                 return int(out) if classification else float(out)
+        elif marshal == "ffm_predict":
+            from functools import lru_cache
+
+            from ..models.ffm import TrainedFFMModel
+
+            # one decode per distinct blob, not per (row x call); bytes are
+            # hashable so the blob itself is the cache key
+            cached_from_blob = lru_cache(maxsize=8)(TrainedFFMModel.from_blob)
+
+            def fn(blob, features, _c=cached_from_blob):
+                if blob is None or features is None:
+                    return None
+                m = _c(bytes(blob))
+                return float(m.predict([parse_features(features)])[0])
         elif marshal == "mf_predict":
             base_mf = get_function(target)
 
@@ -333,18 +353,23 @@ def _materialize_fm(q, model, model_table: str) -> None:
 
 
 def _materialize_ffm(q, model, model_table: str) -> None:
-    """FFM materializes its LINEAR part only — `(feature, wi)` + the w0
-    bias on feature -1. The field-aware V table is deliberately not
-    emitted as rows: the reference likewise ships FFM models as an opaque
-    compressed blob, not joinable rows (ref: FFMPredictionModel
-    Externalizable, fm/FFMPredictionModel.java:46-200); pairwise scoring
-    stays framework-side via the returned model object's predict()."""
+    """FFM materializes its LINEAR part as joinable `(feature, wi)` rows
+    (+ w0 on feature -1) AND the complete model as a one-row compressed
+    blob table `{model_table}_blob` — exactly the reference's shipping
+    shape: an opaque Externalizable blob scored by a dedicated UDF
+    (ref: FFMPredictionModel.java:46-200 + FFMPredictUDF). Score in SQL
+    with `ffm_predict(blob, features)` — full pairwise parity with the
+    framework's predict, V included."""
     feats, w, w0 = model.model_rows()
     q.execute(f"CREATE TABLE {model_table} "
               "(feature INTEGER PRIMARY KEY, wi REAL)")
     q.execute(f"INSERT INTO {model_table} VALUES (-1, ?)", (float(w0),))
     q.executemany(f"INSERT INTO {model_table} VALUES (?,?)",
                   zip(map(int, feats), map(float, w)))
+    q.execute(f"DROP TABLE IF EXISTS {model_table}_blob")
+    q.execute(f"CREATE TABLE {model_table}_blob (model BLOB)")
+    q.execute(f"INSERT INTO {model_table}_blob VALUES (?)",
+              (model.to_blob(),))
 
 
 def _materialize_forest(q, model, model_table: str) -> None:
@@ -495,6 +520,10 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
             "predict on the returned model object")
     q = conn.cursor()
     q.execute(f"DROP TABLE IF EXISTS {model_table}")
+    # a previous train_ffm into this name also left {model_table}_blob;
+    # retraining with another family must not leave ffm_predict silently
+    # scoring the outdated blob
+    q.execute(f"DROP TABLE IF EXISTS {model_table}_blob")
     materialize(q, model, model_table)
     conn.commit()
     return model
@@ -563,7 +592,11 @@ def explode_features(conn: sqlite3.Connection, src_query: str,
     (SURVEY.md §3.5). String feature names are hashed like
     feature_hashing() (ref: ftvec/hashing/FeatureHashingUDF.java:172);
     `num_features` is REQUIRED when names are strings and must match the
-    trainer's `-dims` (same feature space as the model table)."""
+    trainer's `-dims` (same feature space as the model table). Integer ids
+    are floor-modded into [0, num_features) exactly like every trainer's
+    parser (`int(name) % num_features`, matching the C bulk parser), so
+    out-of-range and negative ids land on the same model rows the trainer
+    wrote — without the mod the join silently drops them."""
     from ..utils.feature import parse_feature
     from ..utils.hashing import mhash
 
@@ -585,6 +618,14 @@ def explode_features(conn: sqlite3.Connection, src_query: str,
                         "num_features= matching the trainer's -dims so it "
                         "hashes into the model's feature space")
                 idx = mhash(name, num_features)
+            else:
+                if num_features is not None:
+                    idx %= num_features
+                elif idx < 0:
+                    raise ValueError(
+                        f"feature id {idx} is negative; pass num_features= "
+                        "matching the trainer's -dims so it floor-mods into "
+                        "the model's feature space like the trainer did")
             ins.append((rid, idx, float(value)))
     q = conn.cursor()
     q.execute(f"DROP TABLE IF EXISTS {out_table}")

@@ -371,16 +371,23 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
     return jax.jit(fn, donate_argnums=(0,)) if jit else fn
 
 
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _ffm_scores_jit(hyper: FFMHyper, st: FFMState, idx, val, fld):
+    def one(i, v, f):
+        p, _, _, _ = _row_predict(st, i, v, f, hyper)
+        return p
+
+    return jax.vmap(one)(idx, val, fld)
+
+
 def _ffm_scores(state: FFMState, hyper: FFMHyper, indices, values, fields):
-    @jax.jit
-    def score(st, idx, val, fld):
-        def one(i, v, f):
-            p, _, _, _ = _row_predict(st, i, v, f, hyper)
-            return p
-
-        return jax.vmap(one)(idx, val, fld)
-
-    return score(state, indices, values, fields)
+    # module-level jit (hyper static): repeated same-shape calls — e.g. the
+    # SQL engine's per-row ffm_predict scalar — hit the trace cache instead
+    # of re-tracing a fresh closure every call
+    return _ffm_scores_jit(hyper, state, indices, values, fields)
 
 
 @dataclass
@@ -396,6 +403,95 @@ class TrainedFFMModel:
         touched = np.asarray(self.state.touched) != 0
         feats = np.nonzero(touched)[0]
         return feats, np.asarray(self.state.w)[feats], float(self.state.w0)
+
+    def to_blob(self, half_float: bool = True) -> bytes:
+        """Serialize the whole predictable model to one compressed blob —
+        the FFMPredictionModel.writeExternal analog (ref:
+        fm/FFMPredictionModel.java:46,149-200: ZigZag-LEB128 feature keys +
+        half-float values + compression). The linear part reuses
+        encode_sparse_model (the same recipe); V rows are stored sparsely
+        as (delta-zigzag key, k values) for exactly the rows that differ
+        from the seeded gaussian init — the untouched rest is re-derived
+        from the PRNG at decode, so from_blob().predict reproduces this
+        model's predict (bit-exact with half_float=False)."""
+        import struct as _struct
+
+        from ..utils.codec import (compress_model_blob, encode_sparse_model,
+                                   float_to_half, zigzag_leb128_encode_array)
+
+        st, hy = self.state, self.hyper
+        feats, w, w0 = self.model_rows()
+        w_blob = encode_sparse_model(feats, w, half_float=half_float)
+        v = np.asarray(st.v, np.float32)
+        init_v = np.asarray(
+            jax.random.normal(jax.random.PRNGKey(hy.seed), v.shape)
+            * hy.sigma, np.float32)
+        changed = np.nonzero(np.any(v != init_v, axis=1))[0]
+        vkeys = zigzag_leb128_encode_array(np.diff(changed, prepend=0))
+        vvals = v[changed].ravel()
+        v_bytes = (float_to_half(vvals).tobytes() if half_float
+                   else vvals.astype("<f4").tobytes())
+        flags = ((1 if hy.linear_coeff else 0)
+                 | (2 if hy.global_bias else 0)
+                 | (4 if hy.classification else 0)
+                 | (8 if half_float else 0))
+        header = _struct.pack(
+            "<4sBiqqqqfBf", b"HFM1", 1, hy.factors, hy.num_features,
+            hy.num_fields, hy.v_dims, hy.seed, hy.sigma, flags, w0)
+        v_section = compress_model_blob(
+            _struct.pack("<qq", len(changed), len(vkeys)) + vkeys + v_bytes)
+        return (header + _struct.pack("<qq", len(w_blob), len(v_section))
+                + w_blob + v_section)
+
+    @classmethod
+    def from_blob(cls, blob: bytes) -> "TrainedFFMModel":
+        """Decode a to_blob() emission back into a servable model — the
+        FFMPredictUDF deserialization path (ref: fm/FFMPredictUDF.java +
+        FFMPredictionModel.readExternal)."""
+        import struct as _struct
+
+        from ..utils.codec import (decode_sparse_model,
+                                   decompress_model_blob, half_to_float,
+                                   zigzag_leb128_decode_array)
+
+        magic, version, k, d, nf, dv, seed, sigma, flags, w0 = \
+            _struct.unpack_from("<4sBiqqqqfBf", blob, 0)
+        if magic != b"HFM1" or version != 1:
+            raise ValueError("not an FFM model blob")
+        off = _struct.calcsize("<4sBiqqqqfBf")
+        wlen, vlen = _struct.unpack_from("<qq", blob, off)
+        off += 16
+        feats, w_sparse = decode_sparse_model(blob[off:off + wlen])
+        off += wlen
+        v_section = decompress_model_blob(blob[off:off + vlen])
+        n_changed, keys_len = _struct.unpack_from("<qq", v_section, 0)
+        deltas = zigzag_leb128_decode_array(v_section[16:16 + keys_len],
+                                            n_changed)
+        vkeys = np.cumsum(np.asarray(deltas, np.int64))
+        raw = v_section[16 + keys_len:]
+        if flags & 8:
+            vvals = half_to_float(
+                np.frombuffer(raw, np.float16, count=n_changed * k))
+        else:
+            vvals = np.frombuffer(raw, "<f4", count=n_changed * k).copy()
+        vvals = np.asarray(vvals, np.float32).reshape(n_changed, k)
+
+        hyper = FFMHyper(factors=int(k), classification=bool(flags & 4),
+                         global_bias=bool(flags & 2),
+                         linear_coeff=bool(flags & 1),
+                         num_features=int(d), num_fields=int(nf),
+                         v_dims=int(dv), seed=int(seed), sigma=float(sigma))
+        st = init_ffm_state(hyper)
+        w_full = np.zeros(int(d), np.float32)
+        w_full[np.asarray(feats, np.int64)] = w_sparse
+        touched = np.zeros(int(d), np.int8)
+        touched[np.asarray(feats, np.int64)] = 1
+        v = np.asarray(st.v, np.float32).copy()
+        v[vkeys] = vvals
+        st = st.replace(w0=jnp.asarray(np.float32(w0)),
+                        w=jnp.asarray(w_full), v=jnp.asarray(v),
+                        touched=jnp.asarray(touched))
+        return cls(state=st, hyper=hyper)
 
 
 def _stage_ffm_rows(rows, labels, hyper: FFMHyper):

@@ -116,12 +116,14 @@ def compile_tree(model_type: str, model: str):
 
 
 def tree_predict(model_type: str, model: str, features: Sequence[float],
-                 classification: bool = True) -> Union[int, float]:
+                 classification: bool = False) -> Union[int, float]:
     """Evaluate an exported tree on one raw feature vector. Evaluators:
     opscode -> StackMachine (ref: TreePredictUDF.java:257), json -> node-graph
     walk (the serialization-evaluator analog, :205), javascript -> the
     expression-subset compiler compile_js_tree (the Rhino-evaluator analog,
-    :326)."""
+    :326). `classification` defaults false like the reference
+    (TreePredictUDF.java:104), so regression forests scored via the 3-arg
+    form keep float leaf values instead of silently int-truncating."""
     out = compile_tree(model_type, model)(features)
     return int(out) if classification else float(out)
 

@@ -63,9 +63,11 @@ def main(argv=None) -> int:
     if mport:
         from hivemall_tpu.runtime.metrics_http import serve_metrics
 
-        # cluster workers must be reachable by a remote scraper by default
-        # (the JMX analog is remote too); override with _METRICS_HOST
-        mhost = os.environ.get("HIVEMALL_TPU_METRICS_HOST", "0.0.0.0")
+        # loopback unless the operator opts in: the endpoint is
+        # unauthenticated, so exposing it beyond the host must be an
+        # explicit HIVEMALL_TPU_METRICS_HOST=0.0.0.0 decision (remote
+        # scrapers in a fleet set it in conf/cluster_env.sh)
+        mhost = os.environ.get("HIVEMALL_TPU_METRICS_HOST", "127.0.0.1")
         srv = serve_metrics(int(mport), host=mhost)
         print(f"[launch] metrics on {mhost}:{srv.server_address[1]}/metrics",
               file=sys.stderr, flush=True)

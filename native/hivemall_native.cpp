@@ -10,6 +10,7 @@
 // Build: scripts/build_native.sh (cmake or direct g++).
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
@@ -668,6 +669,111 @@ int64_t hm_forest_eval(const int8_t* ops, const int32_t* argi,
         }
     }
     return 0;
+}
+
+// ------------------------------------------------- reference anchor loop
+//
+// The reference's per-row AROW hot loop, transliterated to C so the anchor
+// the bench divides by is MEASURED on this host instead of assumed
+// (VERDICT r3 missing #2). Semantics per row (one Hive mapper's work,
+// classifier/AROWClassifierUDTF.java:99-150 + the per-set clock/delta
+// bookkeeping of model/DenseModel.java:193-201):
+//   score = sum w[i]*x, variance = sum cov[i]*x^2   (calcScoreAndVariance)
+//   m = score*y; if m < 1: beta = 1/(var+r), alpha = (1-m)*beta
+//   per feature: cv = cov*x; w += y*alpha*cv; cov -= beta*cv*cv
+//   per set: clocks[i]++, deltaUpdates[i]++ (wrapping like short/byte)
+// This deliberately EXCLUDES the JVM's string parse + ObjectInspector +
+// boxed-object costs, so it upper-bounds (flatters) the reference mapper.
+// Returns the count of margin-violating rows so the work can't be
+// dead-code-eliminated.
+int64_t hm_arow_reference_rowloop(const int32_t* idx, const float* val,
+                                  const float* labels, int64_t n_rows,
+                                  int64_t width, float r,
+                                  float* w, float* cov,
+                                  int16_t* clocks, int8_t* deltas) {
+    int64_t violations = 0;
+    for (int64_t row = 0; row < n_rows; ++row) {
+        const int32_t* ki = idx + row * width;
+        const float* kv = val + row * width;
+        const float y = labels[row] > 0.f ? 1.f : -1.f;
+        float score = 0.f, variance = 0.f;
+        for (int64_t j = 0; j < width; ++j) {
+            const float x = kv[j];
+            score += w[ki[j]] * x;
+            variance += cov[ki[j]] * x * x;
+        }
+        const float m = score * y;
+        if (m < 1.f) {
+            ++violations;
+            const float beta = 1.f / (variance + r);
+            const float alpha = (1.f - m) * beta;
+            for (int64_t j = 0; j < width; ++j) {
+                const int32_t k = ki[j];
+                const float cv = cov[k] * kv[j];
+                w[k] += y * alpha * cv;
+                cov[k] -= beta * cv * cv;
+                clocks[k] = (int16_t)(clocks[k] + 1);
+                deltas[k] = (int8_t)(deltas[k] + 1);
+            }
+        }
+    }
+    return violations;
+}
+
+// The reference's per-row FM (train_fm, classification) hot loop, same
+// purpose as hm_arow_reference_rowloop: a measured train_fm anchor.
+// Semantics per row (fm/FactorizationMachineUDTF.java:369-393 trainTheta +
+// fm/FactorizationMachineModel.java:136-160 predict, :209-247 updates),
+// with the fixed-eta schedule and the adaptive-lambda path off (defaults):
+//   p = w0 + sum wi*xi + 0.5*sum_f[(sum Vif*xi)^2 - sum (Vif*xi)^2]
+//   dloss = (sigmoid(p*y) - 1)*y
+//   w0  -= eta*(dloss + 2*l0*w0)
+//   wi  -= eta*(dloss*xi + 2*lw*wi)
+//   Vif -= eta*(dloss*xi*(sumVfX[f] - Vif*xi) + 2*lv*Vif)   (gradV, :76)
+// V is [dims, k] row-major. Returns sign-error count (prevents DCE).
+int64_t hm_fm_reference_rowloop(const int32_t* idx, const float* val,
+                                const float* labels, int64_t n_rows,
+                                int64_t width, int64_t k,
+                                float eta, float lambda,
+                                float* w0_inout, float* w, float* V) {
+    float w0 = *w0_inout;
+    double sumVfX[64];  // k <= 64 (reference default 5)
+    if (k > 64) return -1;
+    int64_t errors = 0;
+    for (int64_t row = 0; row < n_rows; ++row) {
+        const int32_t* ki = idx + row * width;
+        const float* kv = val + row * width;
+        const float y = labels[row] > 0.f ? 1.f : -1.f;
+        double p = w0;
+        for (int64_t j = 0; j < width; ++j) p += (double)w[ki[j]] * kv[j];
+        for (int64_t f = 0; f < k; ++f) {
+            double s = 0.0, s2 = 0.0;
+            for (int64_t j = 0; j < width; ++j) {
+                const double vx = (double)V[(int64_t)ki[j] * k + f] * kv[j];
+                s += vx;
+                s2 += vx * vx;
+            }
+            sumVfX[f] = s;
+            p += 0.5 * (s * s - s2);
+        }
+        if (p * y < 0.0) ++errors;
+        const double z = p * y;
+        const double sig = 1.0 / (1.0 + std::exp(-z));
+        const double dloss = (sig - 1.0) * y;
+        w0 -= eta * ((float)dloss + 2.f * lambda * w0);
+        for (int64_t j = 0; j < width; ++j) {
+            const int32_t i = ki[j];
+            const double xi = kv[j];
+            w[i] -= eta * ((float)(dloss * xi) + 2.f * lambda * w[i]);
+            float* vi = V + (int64_t)i * k;
+            for (int64_t f = 0; f < k; ++f) {
+                const double h = xi * (sumVfX[f] - (double)vi[f] * xi);
+                vi[f] -= eta * ((float)(dloss * h) + 2.f * lambda * vi[f]);
+            }
+        }
+    }
+    *w0_inout = w0;
+    return errors;
 }
 
 }  // extern "C"

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Watch the axon TPU relay; whenever it serves, run whatever is left of the
 # pending hardware suite, appending one JSON line per metric to
-# PERF_TPU_r03.jsonl. Each benchmark is retried on the next uptime window
+# PERF_TPU_r04.jsonl. Each benchmark is retried on the next uptime window
 # until it has produced TPU-labeled output or the deadline passes.
 #
 # The relay drops unpredictably (see PERF.md "relay status"); this watcher
@@ -9,10 +9,10 @@
 #   setsid nohup bash scripts/relay_watch.sh >/tmp/relay_watch.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-OUT=PERF_TPU_r03.jsonl
+OUT=PERF_TPU_r04.jsonl
 # versioned so markers written by an older watcher's laxer success criteria
 # can never retire a benchmark under the current ones
-DONE_DIR=/tmp/relay_watch_done_v2
+DONE_DIR=/tmp/relay_watch_done_r04
 mkdir -p "$DONE_DIR"
 # preserve results published by any earlier watcher version that appended
 # straight to $OUT — the regeneration below would otherwise truncate them.
@@ -21,7 +21,7 @@ mkdir -p "$DONE_DIR"
 if [ -f "$OUT" ] && ! ls "$DONE_DIR"/*.jsonl >/dev/null 2>&1; then
   cp "$OUT" "$DONE_DIR/_legacy.jsonl"
 fi
-DEADLINE=$(( $(date +%s) + 9*3600 ))
+DEADLINE=$(( $(date +%s) + 11*3600 ))
 
 publish() {  # publish <tag> <lines-file>: keep each tag's LATEST capture and
   # regenerate $OUT from all tags — a clean rerun replaces its own earlier
@@ -36,6 +36,11 @@ probe() {
     >/dev/null 2>&1
 }
 
+is_tpu_output() {  # round-4 bench.py carries platform as a JSON FIELD;
+  # the per-family scripts still embed it in the metric name
+  grep -qE '_tpu|"platform": *"tpu"' "$1"
+}
+
 run_one() {  # run_one <tag> <cmd...>
   local tag=$1; shift
   [ -e "$DONE_DIR/$tag" ] && return 0
@@ -48,13 +53,12 @@ run_one() {  # run_one <tag> <cmd...>
   # retries from stacking conflicting records), but only a clean rc=0 run
   # retires the tag
   set -o pipefail
-  timeout 900 "$@" 2>>/tmp/relay_watch_err.log \
+  timeout 1500 "$@" 2>>/tmp/relay_watch_err.log \
     | grep --line-buffered '^{' > "$tmp"
   rc=$?
   set +o pipefail
-  # a CPU-fallback or zero-value run must not retire the tag or publish:
-  # every script embeds the jax platform in its metric name
-  if grep -q '_tpu' "$tmp"; then
+  # a CPU-fallback or zero-value run must not retire the tag or publish
+  if is_tpu_output "$tmp"; then
     publish "$tag" "$tmp"
     if [ "$rc" -eq 0 ]; then
       touch "$DONE_DIR/$tag"
