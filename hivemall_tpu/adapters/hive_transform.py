@@ -233,7 +233,9 @@ def _parse_predict_args(argv: Sequence[str], flags: Sequence[str] = ()):
 
 def _run_predict_linear(argv: Sequence[str], src: IO[str],
                         out: IO[str]) -> int:
-    import math
+    # overflow-safe sigmoid (math.exp raises OverflowError past ~|710|,
+    # which real CTR scores can reach; the library sigmoid is np-based)
+    from ..tools import sigmoid
 
     model_path, flags = _parse_predict_args(argv, flags=("sigmoid",))
     weights = {}
@@ -264,7 +266,7 @@ def _run_predict_linear(argv: Sequence[str], src: IO[str],
                 return 2
             score += weights.get(k, 0.0) * value
         if use_sigmoid:
-            score = 1.0 / (1.0 + math.exp(-score))
+            score = float(sigmoid(score))
         _emit(out, cols[0], score)
     return 0
 

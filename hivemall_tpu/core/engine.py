@@ -173,23 +173,31 @@ def make_train_fn(
                 gl = rule.pre_row(gl, y)
             ctx, sidx = build_ctx((weights, covars, slots), idx, val, y, tf, gl)
             out = rule.update(ctx, hyper)
-            weights = weights.at[sidx].add(out.dw, mode="drop")
+            # rule math runs in f32; bf16 tables (the SpaceEfficientDenseModel
+            # analog) take the delta cast to their storage dtype
+            weights = weights.at[sidx].add(
+                out.dw.astype(weights.dtype), mode="drop")
             if use_cov and out.dcov is not None:
-                covars = covars.at[sidx].add(out.dcov, mode="drop")
+                covars = covars.at[sidx].add(
+                    out.dcov.astype(covars.dtype), mode="drop")
             new_slots = dict(slots)
             for k, d in out.dslots.items():
-                new_slots[k] = slots[k].at[sidx].add(d, mode="drop")
+                new_slots[k] = slots[k].at[sidx].add(
+                    d.astype(slots[k].dtype), mode="drop")
             if rule.derive_w is not None:
                 # lane-wise slot values after this row's delta
                 sl_new = {k: ctx.slots[k] + out.dslots.get(k, 0.0) for k in slots}
                 w_new = rule.derive_w(sl_new, tf, hyper)
                 w_new = jnp.where(out.updated, w_new, ctx.w)
-                weights = weights.at[sidx].set(w_new, mode="drop")
+                weights = weights.at[sidx].set(
+                    w_new.astype(weights.dtype), mode="drop")
             upd = out.updated.astype(jnp.int8)
             touched = touched.at[sidx].max(jnp.broadcast_to(upd, sidx.shape), mode="drop")
             if track_deltas:
                 new_slots[DELTA_SLOT] = slots[DELTA_SLOT].at[sidx].add(
-                    jnp.broadcast_to(out.updated.astype(jnp.float32), sidx.shape),
+                    jnp.broadcast_to(
+                        out.updated.astype(slots[DELTA_SLOT].dtype),
+                        sidx.shape),
                     mode="drop")
             return (weights, covars, new_slots, touched, t + 1, gl), out.loss
 
@@ -225,21 +233,33 @@ def make_train_fn(
         if mini_batch_average:
             # Per-feature averaged application, exactly the reference's
             # FloatAccumulator semantics (RegressionBaseUDTF.java:236-295).
-            counts = jnp.zeros_like(weights).at[sidx].add(lane_upd, mode="drop")
+            # Accumulate in f32 even over bf16 tables, cast once at the
+            # table write (the SpaceEfficientDenseModel analog stores
+            # compact, never accumulates compact).
+            acc = jnp.promote_types(weights.dtype, jnp.float32)
+            counts = jnp.zeros(weights.shape, acc).at[sidx].add(
+                lane_upd, mode="drop")
             denom = jnp.maximum(counts, 1.0)
-            dw_sum = jnp.zeros_like(weights).at[sidx].add(outs.dw, mode="drop")
-            weights = weights + dw_sum / denom
+            dw_sum = jnp.zeros(weights.shape, acc).at[sidx].add(
+                outs.dw.astype(acc), mode="drop")
+            weights = (weights.astype(acc) + dw_sum / denom) \
+                .astype(weights.dtype)
             if use_cov and outs.dcov is not None:
-                dc_sum = jnp.zeros_like(covars).at[sidx].add(outs.dcov, mode="drop")
-                covars = covars + dc_sum / denom
+                dc_sum = jnp.zeros(covars.shape, acc).at[sidx].add(
+                    outs.dcov.astype(acc), mode="drop")
+                covars = (covars.astype(acc) + dc_sum / denom) \
+                    .astype(covars.dtype)
         else:
-            weights = weights.at[sidx].add(outs.dw, mode="drop")
+            weights = weights.at[sidx].add(
+                outs.dw.astype(weights.dtype), mode="drop")
             if use_cov and outs.dcov is not None:
-                covars = covars.at[sidx].add(outs.dcov, mode="drop")
+                covars = covars.at[sidx].add(
+                    outs.dcov.astype(covars.dtype), mode="drop")
         new_slots = dict(slots)
         for k in rule.slot_names:
             if k in outs.dslots:
-                new_slots[k] = slots[k].at[sidx].add(outs.dslots[k], mode="drop")
+                new_slots[k] = slots[k].at[sidx].add(
+                    outs.dslots[k].astype(slots[k].dtype), mode="drop")
         if rule.derive_w is not None:
             # Dual-averaging weights are a pure function of the *updated*
             # accumulators — gather-after-scatter makes duplicate features
@@ -249,13 +269,15 @@ def make_train_fn(
             w_new = rule.derive_w(sl_g, tf_end, hyper)  # [B, K]
             keep = _gather(weights, sidx)
             w_new = jnp.where(lane_upd > 0, w_new, keep)
-            weights = weights.at[sidx].set(w_new, mode="drop")
+            weights = weights.at[sidx].set(
+                w_new.astype(weights.dtype), mode="drop")
         touched = state.touched.at[sidx].max(
             lane_upd.astype(jnp.int8), mode="drop"
         )
         if track_deltas:
-            new_slots[DELTA_SLOT] = new_slots.get(DELTA_SLOT, state.slots[DELTA_SLOT]) \
-                .at[sidx].add(lane_upd, mode="drop")
+            delta_tab = new_slots.get(DELTA_SLOT, state.slots[DELTA_SLOT])
+            new_slots[DELTA_SLOT] = delta_tab.at[sidx].add(
+                lane_upd.astype(delta_tab.dtype), mode="drop")
         new_state = state.replace(
             weights=weights,
             covars=covars,

@@ -280,11 +280,17 @@ class TrainedGBT:
         return self.classes[np.argmax(s, axis=1)]
 
 
-def train_gradient_tree_boosting_classifier(X, labels, options: Optional[str] = None
-                                            ) -> TrainedGBT:
+def train_gradient_tree_boosting_classifier(X, labels, options: Optional[str] = None,
+                                            row_shard=None) -> TrainedGBT:
     """Binary: logistic loss on y in {-1,1}, pseudo-response 2y/(1+e^{2yF}),
     shrinkage eta, row subsampling (ref: GradientTreeBoostingClassifierUDTF.java:70-658).
-    Multiclass: softmax with K trees per round."""
+    Multiclass: softmax with K trees per round.
+
+    `row_shard=(mesh, axis)`: every boosting round's histogram build runs
+    over device-sharded rows with one psum per level (grow.py
+    _sharded_hist_fn) — GBT scales with devices where the reference's
+    per-tree thread pool cannot help its sequential rounds
+    (parallel/forest_shard.train_gbt_data_parallel is the public wrapper)."""
     cl = _forest_options(gbt=True).parse(options, "train_gradient_tree_boosting_classifier")
     X = np.asarray(X, dtype=np.float64)
     y_raw = np.asarray(labels)
@@ -311,7 +317,7 @@ def train_gradient_tree_boosting_classifier(X, labels, options: Optional[str] = 
                          classification=False, max_depth=depth, min_split=min_split,
                          min_leaf=cl.get_int("min_samples_leaf", 1),
                          max_leaf_nodes=cl.get_int("leafs", 512),
-                         num_vars=num_vars, rng=rng)
+                         num_vars=num_vars, rng=rng, row_shard=row_shard)
 
     rounds: List[List[TreeArrays]] = []
     if K == 2:
@@ -348,7 +354,7 @@ def train_gradient_tree_boosting_classifier(X, labels, options: Optional[str] = 
             classification=False, max_depth=depth, min_split=min_split,
             min_leaf=cl.get_int("min_samples_leaf", 1),
             max_leaf_nodes=cl.get_int("leafs", 512),
-            num_vars=num_vars, rngs=round_rngs)
+            num_vars=num_vars, rngs=round_rngs, row_shard=row_shard)
         leaf_vals = np.asarray(
             predict_forest_binned(stack_trees(round_trees), Xb))  # [K, N]
         Fx += eta * leaf_vals.T

@@ -44,6 +44,7 @@ _CONN: Dict[Tuple[str, str], int] = {
     (P, V): -50,         # particle then verb
     (P, N): -50,
     (PRE, N): -150,      # この+人
+    ("接頭詞", N): -200,  # お+風呂 (prefix binds to the following noun)
     (N, AUX): -50,       # noun + copula です/だ
     (N, N): 150,         # discourage spurious noun-noun splits vs compounds
     (P, P): 100,         # two particles in a row happens (には) but rarer

@@ -39,9 +39,12 @@ _PARTICLES = [
 ]
 
 _AUXILIARIES = [
-    # copulas + inflecting auxiliaries, IPADic-style split units
-    "です", "でした", "でしょう", "だ", "だった", "だろう", "である",
-    "ます", "まし", "ませ", "ましょう", "た", "て", "で",
+    # copulas + inflecting auxiliaries, IPADic-style split units: です
+    # conjugates でし+た / でしょ+う, だ conjugates だっ+た / だろ+う,
+    # ます conjugates まし+た / ましょ+う (the fused surfaces でした etc.
+    # are NOT entries, exactly like IPADic)
+    "です", "でし", "でしょ", "だ", "だっ", "だろ", "である",
+    "ます", "まし", "ませ", "ましょ", "た", "て", "で",
     "ない", "なかっ", "なく", "ぬ", "ん", "う", "よう",
     "れる", "られる", "れ", "られ", "せる", "させる", "せ", "させ",
     "たい", "たかっ", "そう", "らしい", "みたい", "べき", "ちゃ", "じゃ",
@@ -71,7 +74,24 @@ _NOUNS = [
     "情報", "技術", "言語", "処理", "自然", "国際", "空港", "科学",
     "関西", "関東", "経済", "政治", "社会", "文化", "歴史", "教育",
     "環境", "開発", "分析", "予測", "回帰", "分類", "学会", "論文",
+    # round-4 growth toward the gold-set gate (everyday vocabulary)
+    "椅子", "興味", "窓", "予定", "来週", "来月", "毎朝", "紅茶",
+    "どちら", "妹", "弟", "兄", "姉", "母", "父", "医者", "荷物",
+    "夏休み", "春", "夏", "秋", "冬", "気持ち", "銀行", "番号", "地図",
+    "病院", "薬", "約束", "漢字", "宿題", "歌", "みんな", "景色",
+    "台所", "公園", "散歩", "会議", "資料", "電気", "風呂", "男の子",
+    "女の子", "場所", "道具", "人口", "結果", "準備", "原因", "注目",
+    "確認", "発表", "精度", "基本", "本当", "掃除", "図書館", "たち",
+    # 形容動詞語幹 (na-adjective stems), IPADic files them 名詞
+    "好き", "嫌い", "きれい", "静か", "有名", "大切", "便利", "元気",
+    "大変", "簡単", "上手", "下手", "得意", "親切", "特別", "必要",
+    # numerals + common counters (IPADic 名詞,数 / 名詞,接尾,助数詞)
+    "一", "二", "三", "四", "五", "六", "七", "八", "九", "十",
+    "百", "千", "万", "円", "度", "回", "個", "冊", "枚", "匹",
+    "一つ", "二つ", "三つ", "四つ", "五つ",
 ]
+
+_PREFIXES = ["お", "ご"]  # 接頭詞 (お風呂, ご飯 is lexicalized whole)
 
 _MISC_VERBS = [  # polite/formulaic chunks, IPADic-style single units
     "ください", "下さい", "いただき", "いただく", "くれ", "くれる",
@@ -104,9 +124,13 @@ _KATAKANA_NOUNS = [
 
 _ADVERBS = [
     "とても", "すごく", "少し", "ちょっと", "たくさん", "もっと", "また",
-    "まだ", "もう", "すぐ", "いつも", "時々", "よく", "あまり", "全然",
+    "まだ", "すぐ", "いつも", "時々", "よく", "あまり", "全然",
     "きっと", "たぶん", "やはり", "やっぱり", "一緒に", "ゆっくり",
 ]
+
+# もう gets a below-particle price: the decomposition も(助詞)+う(助動詞)
+# costs 250 on the lattice and is never the right analysis
+_CHEAP_ADVERBS = [("もう", 140)]
 
 _CONJUNCTIONS = ["そして", "しかし", "でも", "だから", "それで", "また",
                  "それから", "つまり", "例えば"]
@@ -118,7 +142,9 @@ _PRENOMINALS = ["この", "その", "あの", "どの", "大きな", "小さな"
 _ICHIDAN = ["食べ", "見", "出", "寝", "起き", "着", "開け", "閉め", "教え",
             "覚え", "忘れ", "考え", "伝え", "感じ", "信じ", "調べ", "続け",
             "始め", "止め", "決め", "入れ", "届け", "受け", "助け", "逃げ",
-            "投げ", "見せ", "乗せ", "任せ", "い", "でき", "生き", "着け"]
+            "投げ", "見せ", "乗せ", "任せ", "い", "でき", "生き", "着け",
+            "借り", "持て", "出かけ", "遅れ", "疲れ", "見つけ", "増え",
+            "まとめ", "覚め", "集め", "比べ"]
 
 _GODAN = [  # (stem-without-final, final dictionary kana)
     ("書", "く"), ("行", "く"), ("聞", "く"), ("歩", "く"), ("働", "く"),
@@ -130,13 +156,18 @@ _GODAN = [  # (stem-without-final, final dictionary kana)
     ("終わ", "る"), ("始ま", "る"), ("売", "る"), ("降", "る"), ("曲が", "る"),
     ("買", "う"), ("会", "う"), ("使", "う"), ("思", "う"), ("言", "う"),
     ("習", "う"), ("歌", "う"), ("洗", "う"), ("笑", "う"), ("手伝", "う"),
+    ("撮", "る"), ("咲", "く"), ("しま", "う"), ("通", "う"), ("送", "る"),
+    ("閉ま", "る"), ("もら", "う"), ("置", "く"), ("消", "す"),
+    ("向か", "う"), ("上が", "る"), ("下が", "る"), ("開", "く"),
+    ("渡", "す"), ("届", "く"), ("探", "す"),
 ]
 
 _I_ADJ_STEMS = ["大き", "小さ", "新し", "古", "高", "安", "良", "悪", "早",
                 "遅", "暑", "寒", "熱", "冷た", "美し", "おいし", "うま",
                 "難し", "易し", "面白", "楽し", "嬉し", "悲し", "忙し",
                 "近", "遠", "長", "短", "強", "弱", "多", "少な", "白",
-                "黒", "赤", "青", "明る", "暗", "若"]
+                "黒", "赤", "青", "明る", "暗", "若", "重", "軽", "涼し",
+                "素晴らし", "広", "狭", "深", "浅"]
 
 # godan conjugation rows: final kana -> (a, i, e, o, onbin-ta-form)
 _GODAN_ROWS = {
@@ -227,10 +258,16 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
         add(w, N, _COSTS[N] + 100)
     for w in _ADVERBS:
         add(w, ADV, _COSTS[ADV])
+    for w, cost in _CHEAP_ADVERBS:
+        add(w, ADV, cost)
     for w in _CONJUNCTIONS:
         add(w, CONJ, _COSTS[CONJ])
     for w in _PRENOMINALS:
         add(w, PRE, _COSTS[PRE])
+    for w in _PREFIXES:
+        # 接頭詞: priced between particles and nouns so お+噌 never beats a
+        # lexicalized whole word (ご飯 stays ご飯) but お風呂 -> お/風呂
+        add(w, "接頭詞", 320)
     for w in _MISC_VERBS:
         add(w, V, _COSTS[V])
     for w in _INTERJECTIONS:

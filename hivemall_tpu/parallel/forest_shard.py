@@ -112,6 +112,31 @@ def train_randomforest_sharded(
     return forest
 
 
+def train_gbt_data_parallel(X, y, options: str = "", mesh=None):
+    """Data-parallel gradient tree boosting over a device mesh.
+
+    Boosting rounds are inherently sequential, so the reference's per-tree
+    thread pool buys GBT nothing (SmileTaskExecutor parallelizes across
+    trees; a round's tree depends on the previous round's output). The
+    device-scalable axis is WITHIN each round: the [S, F, B, C] histogram
+    build over all N rows. Here rows shard across the mesh, each device
+    scatter-adds its partial histogram, and one psum per tree level
+    reduces them (models/trees/grow.py::_sharded_hist_fn); the split
+    search and all growth decisions then run on the replicated global
+    histogram, identical to single-device growth up to float reduction
+    order. Same trick the sharded RF path gets for free via grow_forest's
+    row_shard."""
+    from ..models.trees.forest import train_gradient_tree_boosting_classifier
+    from .mesh import make_mesh
+
+    mesh = mesh if mesh is not None else make_mesh()
+    if len(mesh.axis_names) != 1:
+        raise ValueError("train_gbt_data_parallel needs a 1-D mesh, got "
+                         f"axes {mesh.axis_names}")
+    return train_gradient_tree_boosting_classifier(
+        X, y, options, row_shard=(mesh, mesh.axis_names[0]))
+
+
 def ensemble_predict_rows(model_rows: Sequence[Tuple], X,
                           classification: bool = True,
                           classes=None) -> np.ndarray:

@@ -120,13 +120,20 @@ class ShardedTrainer:
 
     def __init__(self, rule: Rule, hyper: dict, dims: int,
                  mesh: Optional[Mesh] = None, mode: str = "minibatch",
-                 mini_batch_average: bool = True):
+                 mini_batch_average: bool = True, dtype=None):
         self.rule = rule
         self.hyper = hyper
         self.dims = dims
         self.mesh, self.axis, n = _resolve_1d_mesh(mesh, "ShardedTrainer")
         self.stripe = -(-dims // n)  # ceil: arbitrary dims pad up
         self.dims_padded = self.stripe * n
+        # SpaceEfficientDenseModel analog, same policy as models/base.py
+        # fit_linear: above the reference's default 2^24 dims, tables store
+        # bf16 (ref: LearnerBaseUDTF.java:172-175 switches to half-float
+        # there); pass dtype=jnp.float32 for the -disable_halffloat analog
+        if dtype is None:
+            dtype = jnp.bfloat16 if dims > (1 << 24) else jnp.float32
+        self.dtype = dtype
 
         body_fn = make_train_fn(rule, hyper, mode=mode,
                                 mini_batch_average=mini_batch_average,
@@ -148,6 +155,7 @@ class ShardedTrainer:
         )
 
     def _init_one(self, **kwargs) -> LinearState:
+        kwargs.setdefault("dtype", self.dtype)
         return init_linear_state(
             self.dims_padded,
             use_covariance=self.rule.use_covariance,

@@ -1,7 +1,9 @@
 """Forest-training benchmark: batched level-synchronous growth (grow_forest)
-vs the per-tree loop (grow_tree) on the same bootstrap bags.
+vs the per-tree loop (grow_tree) on the same bootstrap bags, plus a GBT
+mode timing single-device vs data-parallel boosting rounds.
 
 Usage: python scripts/bench_forest.py [N] [F] [T]
+       python scripts/bench_forest.py --gbt [N] [F] [rounds]
 """
 
 import json
@@ -17,7 +19,56 @@ from hivemall_tpu.models.trees.binning import bin_data, make_bins
 from hivemall_tpu.models.trees.grow import grow_forest, grow_tree
 
 
+def main_gbt(args):
+    """Single-device vs data-parallel GBT rounds (the psum'd histogram
+    build, parallel/forest_shard.train_gbt_data_parallel)."""
+    import jax
+
+    from hivemall_tpu.models.trees.forest import \
+        train_gradient_tree_boosting_classifier
+    from hivemall_tpu.parallel import make_mesh
+    from hivemall_tpu.parallel.forest_shard import train_gbt_data_parallel
+
+    N = int(args[0]) if len(args) > 0 else 50000
+    F = int(args[1]) if len(args) > 1 else 20
+    rounds = int(args[2]) if len(args) > 2 else 16
+    rng = np.random.RandomState(0)
+    X = rng.rand(N, F)
+    y = ((X[:, 0] > 0.5) ^ (X[:, 1] > 0.5) | (X[:, 2] > 0.8)).astype(int)
+    opts = f"-trees {rounds} -iters {rounds} -depth 6 -seed 3"
+    n_dev = len(jax.devices())
+    mesh = make_mesh(n_dev)
+
+    # warm both paths' compiles on a sliver
+    train_gradient_tree_boosting_classifier(
+        X[:512], y[:512], "-trees 2 -iters 2 -depth 3 -seed 1")
+    train_gbt_data_parallel(X[:n_dev * 64], y[:n_dev * 64],
+                            "-trees 2 -iters 2 -depth 3 -seed 1", mesh)
+
+    t0 = time.perf_counter()
+    single = train_gradient_tree_boosting_classifier(X, y, opts)
+    t_single = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    par = train_gbt_data_parallel(X, y, opts, mesh)
+    t_par = time.perf_counter() - t0
+    acc_s = float(np.mean(single.predict(X) == y))
+    acc_p = float(np.mean(par.predict(X) == y))
+    print(json.dumps({
+        "metric": f"gbt_{rounds}rounds_{N}rows_{F}feat_depth6_dataparallel_"
+                  f"{jax.devices()[0].platform}",
+        "value": round(t_par, 3),
+        "unit": "sec",
+        "single_device_sec": round(t_single, 3),
+        "n_devices": n_dev,
+        "speedup": round(t_single / t_par, 2),
+        "train_acc_single": round(acc_s, 4),
+        "train_acc_parallel": round(acc_p, 4),
+    }), flush=True)
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--gbt":
+        return main_gbt(sys.argv[2:])
     N = int(sys.argv[1]) if len(sys.argv) > 1 else 20000
     F = int(sys.argv[2]) if len(sys.argv) > 2 else 20
     T = int(sys.argv[3]) if len(sys.argv) > 3 else 32
