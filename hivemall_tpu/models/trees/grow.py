@@ -305,7 +305,7 @@ def grow_tree(
     rows with one psum per level (_sharded_hist_fn) — data parallelism the
     reference's single-JVM growth cannot express."""
     rng = rng or np.random.RandomState(0)
-    n_real = np.asarray(Xb).shape[0]
+    n_real = np.shape(Xb)[0]
     if row_shard is not None:
         mesh_, axis_ = row_shard
         (y, w), Xb, _ = _pad_rows([np.asarray(y), np.asarray(w)],
@@ -547,7 +547,7 @@ def grow_forest(
     rounds (VERDICT r3 weak #6)."""
     y = np.asarray(y)
     per_tree_y = (not classification) and y.ndim == 2
-    n_real = np.asarray(Xb).shape[0]
+    n_real = np.shape(Xb)[0]
     if row_shard is not None:
         mesh_, axis_ = row_shard
         (y, W), Xb, _ = _pad_rows([y, W], np.asarray(Xb),

@@ -39,11 +39,12 @@ def main_gbt(args):
     n_dev = len(jax.devices())
     mesh = make_mesh(n_dev)
 
-    # warm both paths' compiles on a sliver
-    train_gradient_tree_boosting_classifier(
-        X[:512], y[:512], "-trees 2 -iters 2 -depth 3 -seed 1")
-    train_gbt_data_parallel(X[:n_dev * 64], y[:n_dev * 64],
-                            "-trees 2 -iters 2 -depth 3 -seed 1", mesh)
+    # warm both paths at the TIMED shapes (full N and depth — the jitted
+    # histogram builders retrace per (N, S_pad), so a sliver warm-up would
+    # leave compiles inside the timed region)
+    warm = "-trees 2 -iters 2 -depth 6 -seed 1"
+    train_gradient_tree_boosting_classifier(X, y, warm)
+    train_gbt_data_parallel(X, y, warm, mesh)
 
     t0 = time.perf_counter()
     single = train_gradient_tree_boosting_classifier(X, y, opts)

@@ -16,20 +16,22 @@ def main() -> None:
     from hivemall_tpu.nlp.evaluate import load_gold, segmentation_prf
     from hivemall_tpu.nlp.tokenizer import backend_name
 
-    gold = load_gold(os.path.join(os.path.dirname(__file__), "..",
-                                  "tests", "data", "tokenize_ja_gold.tsv"))
-    pairs = [(toks, tokenize_ja(sent)) for sent, toks in gold]
-    m = segmentation_prf(pairs)
-    print(json.dumps({
-        "metric": "tokenize_ja_gold_f1",
-        "value": round(m["f1"], 4),
-        "unit": "span_f1",
-        "precision": round(m["precision"], 4),
-        "recall": round(m["recall"], 4),
-        "sentences": len(gold),
-        "gold_tokens": m["gold_tokens"],
-        "backend": backend_name(),
-    }))
+    data_dir = os.path.join(os.path.dirname(__file__), "..", "tests", "data")
+    for tag, fname in (("dev", "tokenize_ja_gold.tsv"),
+                       ("heldout", "tokenize_ja_heldout.tsv")):
+        gold = load_gold(os.path.join(data_dir, fname))
+        pairs = [(toks, tokenize_ja(sent)) for sent, toks in gold]
+        m = segmentation_prf(pairs)
+        print(json.dumps({
+            "metric": f"tokenize_ja_{tag}_f1",
+            "value": round(m["f1"], 4),
+            "unit": "span_f1",
+            "precision": round(m["precision"], 4),
+            "recall": round(m["recall"], 4),
+            "sentences": len(gold),
+            "gold_tokens": m["gold_tokens"],
+            "backend": backend_name(),
+        }))
 
 
 if __name__ == "__main__":
