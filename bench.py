@@ -45,7 +45,10 @@ def _measure_anchors() -> dict:
     out = {
         "kind": "c_transliterated_reference_rowloop_this_host",
         "note": ("sequential per-row loop, JVM parse/boxing excluded "
-                 "(flatters the reference); see native/hivemall_native.cpp"),
+                 "(flatters the reference); see native/hivemall_native.cpp. "
+                 "The same loop ships as the -native_scan execution "
+                 "backend (train_arow), so host-only workers match this "
+                 "anchor by construction"),
         "estimated_jvm_mapper_rows_per_sec": ESTIMATED_JVM_MAPPER_ROWS_PER_SEC,
     }
     if not native.available():

@@ -36,6 +36,15 @@ materializations):
   (LearnerBaseUDTF.java:215-333) without a JVM
 - ``predict_fm -loadmodel <file>``                 (rowid, features) ->
   (rowid, score) over a train_fm TSV model
+- ``predict_ffm -loadmodel <blob-file>``           (rowid, ffm features)
+  -> (rowid, score) over a compressed TrainedFFMModel blob (full
+  pairwise scoring, V included)
+- ``predict_multiclass -loadmodel <file>``         (rowid, features) ->
+  (rowid, best_label, best_score) over a multiclass TSV model (the
+  per-label SUM + max_label plan)
+- ``predict_forest -loadmodel <file> [-regression]`` (rowid, dense
+  features) -> (rowid, vote) over a forest TSV model (tree_predict +
+  rf_ensemble)
 
 Run as ``hivemall-tpu <subcommand> ...`` (bin/ shim) or
 ``python -m hivemall_tpu.adapters.hive_transform <subcommand> ...``.
@@ -177,12 +186,17 @@ def _emit_model_rows(trainer: str, model, out: IO[str]) -> None:
             _emit(out, int(f), float(wi),
                   json.dumps([float(x) for x in vi]))
     elif isinstance(model, TrainedFFMModel):
-        # linear part + w0 on -1; V stays framework-side like the
-        # reference's opaque blob (fm/FFMPredictionModel.java:46-200)
+        # joinable linear part (w0 on -1) PLUS the complete model as one
+        # base91 text blob row on feature -2 — the reference ships FFM
+        # models as compressed text blobs the same way
+        # (fm/FFMPredictionModel.java:46-200); predict_ffm consumes it
+        from ..tools import base91
+
         feats, w, w0 = model.model_rows()
-        _emit(out, -1, float(w0))
+        _emit(out, -1, float(w0), None)
         for f, wi in zip(feats, w):
-            _emit(out, int(f), float(wi))
+            _emit(out, int(f), float(wi), None)
+        _emit(out, -2, None, base91(model.to_blob()))
     elif isinstance(model, TrainedForest):
         for mid, mtype, text, imp, oe, ot in model.model_rows():
             _emit(out, int(mid), str(mtype),
@@ -322,6 +336,130 @@ def _run_predict_fm(argv: Sequence[str], src: IO[str], out: IO[str]) -> int:
     return 0
 
 
+def _run_predict_ffm(argv: Sequence[str], src: IO[str], out: IO[str]) -> int:
+    """(rowid, "field:idx:value" features) -> (rowid, score) over a
+    compressed FFM blob file (TrainedFFMModel.to_blob, the
+    FFMPredictionModel shipping shape) — full pairwise scoring, V
+    included. Ship the blob with ADD FILE like any model artifact."""
+    model_path, _ = _parse_predict_args(argv)
+    from ..models.ffm import TrainedFFMModel
+
+    with open(model_path, "rb") as f:
+        raw = f.read()
+    if not raw.startswith(b"HFM1"):
+        # a train_ffm TSV emission (or just its blob row): pull the base91
+        # text from the feature -2 row
+        from ..tools import unbase91
+
+        blob_text = None
+        for line in raw.decode("utf-8", errors="replace").splitlines():
+            c = _cells(line)
+            if c and c[0] == "-2" and len(c) >= 3 and c[2] is not None:
+                blob_text = c[2]
+        if blob_text is None:
+            print("predict_ffm: file is neither a raw blob nor a "
+                  "train_ffm TSV emission with a feature -2 blob row",
+                  file=sys.stderr)
+            return 2
+        raw = unbase91(blob_text)
+    model = TrainedFFMModel.from_blob(raw)
+    ids: List[str] = []
+    rows: List[List[str]] = []
+    for line in src:
+        if not line.strip():
+            continue
+        cols = _cells(line)
+        if len(cols) < 2 or cols[1] is None:
+            continue
+        ids.append(cols[0])
+        rows.append(_feature_list(cols[1]))
+    if not ids:
+        return 0
+    for rid, s in zip(ids, model.predict(rows)):
+        _emit(out, rid, float(s))
+    return 0
+
+
+def _run_predict_multiclass(argv: Sequence[str], src: IO[str],
+                            out: IO[str]) -> int:
+    """(rowid, features) -> (rowid, best_label, best_score) over a
+    multiclass model TSV (label, feature, weight[, covar]) — the per-label
+    SUM + max_label SQL plan, framework-side."""
+    model_path, _ = _parse_predict_args(argv)
+    weights: dict = {}
+    with open(model_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            cols = _cells(line)
+            weights.setdefault(cols[0], {})[int(cols[1])] = float(cols[2])
+    if not weights:
+        print("predict_multiclass: empty model file", file=sys.stderr)
+        return 2
+
+    from ..utils.feature import parse_feature
+
+    for line in src:
+        if not line.strip():
+            continue
+        cols = _cells(line)
+        if len(cols) < 2 or cols[1] is None:
+            continue
+        try:
+            fv = [(int(n), x) for n, x in
+                  (parse_feature(t) for t in _feature_list(cols[1]))]
+        except ValueError:
+            print("predict_multiclass: string feature name — hash features "
+                  "before training/scoring", file=sys.stderr)
+            return 2
+        best_label, best_score = None, None
+        for label, w in weights.items():
+            s = sum(w.get(k, 0.0) * x for k, x in fv)
+            if best_score is None or s > best_score:
+                best_label, best_score = label, s
+        _emit(out, cols[0], best_label, best_score)
+    return 0
+
+
+def _run_predict_forest(argv: Sequence[str], src: IO[str],
+                        out: IO[str]) -> int:
+    """(rowid, dense features) -> (rowid, vote) over a forest model TSV
+    (the 6-column train_randomforest_* emission) — tree_predict +
+    rf_ensemble, framework-side (classification by default; pass
+    -regression for mean leaf values)."""
+    model_path, flags = _parse_predict_args(argv, flags=("regression",))
+    model_rows = []
+    with open(model_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            c = _cells(line)
+            model_rows.append((int(c[0]), c[1], c[2], c[3], c[4], c[5]))
+    if not model_rows:
+        print("predict_forest: empty model file", file=sys.stderr)
+        return 2
+
+    from ..parallel.forest_shard import ensemble_predict_rows
+
+    ids: List[str] = []
+    X: List[List[float]] = []
+    for line in src:
+        if not line.strip():
+            continue
+        cols = _cells(line)
+        if len(cols) < 2 or cols[1] is None:
+            continue
+        ids.append(cols[0])
+        X.append(_dense_list(cols[1]))
+    if not ids:
+        return 0
+    preds = ensemble_predict_rows(model_rows, X,
+                                  classification="regression" not in flags)
+    for rid, p in zip(ids, preds):
+        _emit(out, rid, float(p) if "regression" in flags else int(p))
+    return 0
+
+
 # ----------------------------------------------------------------------- CLI
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -335,13 +473,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return _run_predict_linear(rest, src, out)
     if cmd == "predict_fm":
         return _run_predict_fm(rest, src, out)
+    if cmd == "predict_multiclass":
+        return _run_predict_multiclass(rest, src, out)
+    if cmd == "predict_forest":
+        return _run_predict_forest(rest, src, out)
+    if cmd == "predict_ffm":
+        return _run_predict_ffm(rest, src, out)
 
     from ..sql.registry import REGISTRY
 
     is_trainer = cmd.startswith("train_") or cmd == "logress"
     if cmd not in REGISTRY or not is_trainer:
         print(f"unknown subcommand {cmd!r}; expected a train_* registry "
-              "name, predict_linear, or predict_fm", file=sys.stderr)
+              "name or predict_{linear,fm,ffm,multiclass,forest}",
+              file=sys.stderr)
         return 2
     options = " ".join(rest) if rest else None
     return _run_trainer(cmd, options, src, out)

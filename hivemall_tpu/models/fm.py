@@ -377,6 +377,9 @@ def train_fm(features: FeatureRows, targets, options: Optional[str] = None,
     mode = "minibatch" if mini_batch > 1 else "scan"
     block = mini_batch if mode == "minibatch" else cl.get_int("block_size", 4096)
     iters = cl.get_int("iters", 1)
+    if cl.has("native_scan"):
+        return _train_fm_native_scan(cl, hyper, dims, idx_rows, val_rows,
+                                     targets, width, block, mode, iters)
     step = make_fm_step(hyper, mode)
     state = init_fm_state(dims, hyper)
     rng = np.random.RandomState(hyper.seed)
@@ -394,6 +397,83 @@ def train_fm(features: FeatureRows, targets, options: Optional[str] = None,
         conv.incr_loss(epoch_loss)
         if iters > 1 and conv.is_converged(n):
             break
+    return TrainedFMModel(state=state, hyper=hyper, dims=dims)
+
+
+def _train_fm_native_scan(cl, hyper: FMHyper, dims, idx_rows, val_rows,
+                          targets, width, block, mode, iters
+                          ) -> TrainedFMModel:
+    """`-native_scan`: exact sequential FM epochs through the C row loop
+    (native/hivemall_native.cpp::hm_fm_reference_rowloop — the train_fm
+    bench anchor shipped as a host execution backend, like AROW's in
+    models/base.py). Envelope = where the C loop and the framework step
+    coincide: -classification, a FIXED -eta, no -adareg, per-row scan
+    mode; anything else refuses loudly. Starts from the framework's own
+    seeded V init, so results match the engine's scan mode (one pinned
+    deviation: a feature duplicated WITHIN a row sees in-place partial
+    updates lane to lane, exactly like the reference's per-feature loop,
+    where the engine batch-gathers the row once)."""
+    from .. import native
+
+    problems = []
+    if not hyper.classification:
+        problems.append("-classification (the C loop is the logistic form)")
+    if hyper.eta.kind != "fixed":
+        problems.append("a fixed -eta (C runs a constant learning rate)")
+    if hyper.adareg:
+        problems.append("no -adareg")
+    if mode != "scan":
+        problems.append("per-row scan mode (drop -mini_batch)")
+    if problems:
+        raise ValueError("-native_scan for train_fm requires: "
+                         + "; ".join(problems))
+    state0 = init_fm_state(dims, hyper)
+    k = hyper.factors
+    # one sentinel slot at index dims: block padding writes land there and
+    # are sliced off (value-0 lanes still take the L2 decay term, like the
+    # reference's own loop — confined to the sentinel)
+    st = {
+        "w0": np.zeros(1, np.float32),
+        "w": np.concatenate([np.asarray(state0.w), np.zeros(1, np.float32)]),
+        "V": np.concatenate([np.asarray(state0.v),
+                             np.zeros((1, k), np.float32)]),
+        "touch": np.zeros(dims + 1, np.uint8),
+    }
+    # zero-row probe: availability check that cannot touch the state
+    # (a fake row would shift the GLOBAL w0 — advisor-caught)
+    probe = native.fm_reference_rowloop(
+        np.zeros((0, 1), np.int32), np.zeros((0, 1), np.float32),
+        np.zeros(0, np.float32), dims + 1, k=k, eta=hyper.eta.eta0,
+        lam=hyper.lambda0, state=st, track_touched=True)
+    if probe is None:
+        raise RuntimeError("-native_scan requires the native library "
+                           "(bash scripts/build_native.sh)")
+    n = len(idx_rows)
+    conv = ConversionState(not cl.has("disable_cv"),
+                           cl.get_float("cv_rate", 0.005))
+    for it in range(max(1, iters)):
+        if cl.has("shuffle") and it > 0:
+            idx_rows, val_rows, targets = shuffle_rows(
+                idx_rows, val_rows, targets, hyper.seed + it)
+        epoch_errors = 0
+        for blk in iter_blocks(idx_rows, val_rows, targets, dims, block,
+                               width):
+            epoch_errors += native.fm_reference_rowloop(
+                blk.indices, blk.values, blk.labels, dims + 1, k=k,
+                eta=hyper.eta.eta0, lam=hyper.lambda0, state=st,
+                track_touched=True)
+        # convergence proxy = sign-error count (the C loop's return);
+        # the engine tracks logloss — documented deviation
+        conv.incr_loss(float(epoch_errors))
+        if iters > 1 and conv.is_converged(n):
+            break
+    state = state0.replace(
+        w0=jnp.asarray(np.float32(st["w0"][0])),
+        w=jnp.asarray(st["w"][:dims]),
+        v=jnp.asarray(st["V"][:dims]),
+        touched=jnp.asarray((st["touch"][:dims] != 0).astype(np.int8)),
+        step=jnp.asarray(np.int32(n * (it + 1))),
+    )
     return TrainedFMModel(state=state, hyper=hyper, dims=dims)
 
 

@@ -81,7 +81,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_float,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
         ]
     except AttributeError:  # older .so without the anchor loop
         pass
@@ -92,6 +92,7 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_float, ctypes.c_float,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p,
         ]
     except AttributeError:  # older .so without the FM anchor loop
         pass
@@ -342,7 +343,8 @@ def parse_features_bulk(rows: Sequence[Sequence[str]], num_features: int
 
 def arow_reference_rowloop(idx: np.ndarray, val: np.ndarray,
                            labels: np.ndarray, dims: int, r: float = 0.1,
-                           state: Optional[dict] = None) -> Optional[int]:
+                           state: Optional[dict] = None,
+                           track_touched: bool = False) -> Optional[int]:
     """Run the reference's per-row AROW hot loop (C transliteration of
     AROWClassifierUDTF.java:99-150 + DenseModel.java:193-201 set
     bookkeeping) over [n_rows, width] gathered blocks. This is the MEASURED
@@ -350,7 +352,13 @@ def arow_reference_rowloop(idx: np.ndarray, val: np.ndarray,
     row loop with the JVM's parse/boxing costs excluded (flattering the
     reference). Mutates/allocates flat model arrays in `state` (reused
     across calls when passed); returns margin-violation count, or None
-    without the library."""
+    without the library.
+
+    `track_touched`: maintain a monotone uint8 `state["touch"]` was-ever-
+    set flag per feature — the -native_scan backend's model-emission mask
+    (clocks/deltas wrap like the reference's short/byte counters and can
+    NOT serve as touched). Anchor measurements leave it off so the timed
+    loop stays the pure reference transliteration."""
     lib = _load()
     if lib is None or not hasattr(lib, "hm_arow_reference_rowloop"):
         return None
@@ -362,6 +370,8 @@ def arow_reference_rowloop(idx: np.ndarray, val: np.ndarray,
         state["cov"] = np.ones(dims, np.float32)
         state["clocks"] = np.zeros(dims, np.int16)
         state["deltas"] = np.zeros(dims, np.int8)
+    if track_touched and "touch" not in state:
+        state["touch"] = np.zeros(dims, np.uint8)
     idx = np.ascontiguousarray(idx, np.int32)
     val = np.ascontiguousarray(val, np.float32)
     labels = np.ascontiguousarray(labels, np.float32)
@@ -369,18 +379,21 @@ def arow_reference_rowloop(idx: np.ndarray, val: np.ndarray,
     return int(lib.hm_arow_reference_rowloop(
         as_p(idx), as_p(val), as_p(labels), n_rows, width,
         ctypes.c_float(r), as_p(state["w"]), as_p(state["cov"]),
-        as_p(state["clocks"]), as_p(state["deltas"])))
+        as_p(state["clocks"]), as_p(state["deltas"]),
+        as_p(state["touch"]) if track_touched else None))
 
 
 def fm_reference_rowloop(idx: np.ndarray, val: np.ndarray,
                          labels: np.ndarray, dims: int, k: int = 5,
                          eta: float = 0.05, lam: float = 0.01,
-                         state: Optional[dict] = None) -> Optional[int]:
+                         state: Optional[dict] = None,
+                         track_touched: bool = False) -> Optional[int]:
     """Run the reference's per-row train_fm (classification) hot loop (C
     transliteration of FactorizationMachineUDTF.java:369-393 trainTheta;
     fixed eta, defaults eta0=0.05 lambda=0.01 per FMHyperParameters.java:
-    30-70) — the measured train_fm anchor. Returns sign-error count, or
-    None without the library."""
+    30-70) — the measured train_fm anchor, and (with `track_touched`) the
+    -native_scan FM backend body. Returns sign-error count, or None
+    without the library."""
     lib = _load()
     if lib is None or not hasattr(lib, "hm_fm_reference_rowloop"):
         return None
@@ -393,6 +406,8 @@ def fm_reference_rowloop(idx: np.ndarray, val: np.ndarray,
         state["w"] = np.zeros(dims, np.float32)
         # sigma=0.1 gaussian rankinit like the reference default
         state["V"] = (0.1 * rng.randn(dims, k)).astype(np.float32)
+    if track_touched and "touch" not in state:
+        state["touch"] = np.zeros(dims, np.uint8)
     idx = np.ascontiguousarray(idx, np.int32)
     val = np.ascontiguousarray(val, np.float32)
     labels = np.ascontiguousarray(labels, np.float32)
@@ -400,7 +415,8 @@ def fm_reference_rowloop(idx: np.ndarray, val: np.ndarray,
     rc = int(lib.hm_fm_reference_rowloop(
         as_p(idx), as_p(val), as_p(labels), n_rows, width, k,
         ctypes.c_float(eta), ctypes.c_float(lam),
-        as_p(state["w0"]), as_p(state["w"]), as_p(state["V"])))
+        as_p(state["w0"]), as_p(state["w"]), as_p(state["V"]),
+        as_p(state["touch"]) if track_touched else None))
     if rc < 0:
         raise ValueError("fm reference rowloop: k > 64 unsupported")
     return rc

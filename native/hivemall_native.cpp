@@ -686,11 +686,19 @@ int64_t hm_forest_eval(const int8_t* ops, const int32_t* argi,
 // boxed-object costs, so it upper-bounds (flatters) the reference mapper.
 // Returns the count of margin-violating rows so the work can't be
 // dead-code-eliminated.
+//
+// `touched` (nullable): monotone per-feature was-ever-set flags for the
+// -native_scan execution backend's model emission — the wrap-prone
+// clock/delta counters mirror DenseModel and CANNOT serve as touched
+// (a count that wraps to 0 would silently drop the feature's model row).
+// Anchor measurements pass NULL so the timed loop stays the pure
+// reference transliteration.
 int64_t hm_arow_reference_rowloop(const int32_t* idx, const float* val,
                                   const float* labels, int64_t n_rows,
                                   int64_t width, float r,
                                   float* w, float* cov,
-                                  int16_t* clocks, int8_t* deltas) {
+                                  int16_t* clocks, int8_t* deltas,
+                                  uint8_t* touched) {
     int64_t violations = 0;
     for (int64_t row = 0; row < n_rows; ++row) {
         const int32_t* ki = idx + row * width;
@@ -714,6 +722,7 @@ int64_t hm_arow_reference_rowloop(const int32_t* idx, const float* val,
                 cov[k] -= beta * cv * cv;
                 clocks[k] = (int16_t)(clocks[k] + 1);
                 deltas[k] = (int8_t)(deltas[k] + 1);
+                if (touched) touched[k] = 1;
             }
         }
     }
@@ -731,11 +740,14 @@ int64_t hm_arow_reference_rowloop(const int32_t* idx, const float* val,
 //   wi  -= eta*(dloss*xi + 2*lw*wi)
 //   Vif -= eta*(dloss*xi*(sumVfX[f] - Vif*xi) + 2*lv*Vif)   (gradV, :76)
 // V is [dims, k] row-major. Returns sign-error count (prevents DCE).
+// `touched` nullable like hm_arow_reference_rowloop's: monotone flags for
+// the -native_scan backend; anchors pass NULL.
 int64_t hm_fm_reference_rowloop(const int32_t* idx, const float* val,
                                 const float* labels, int64_t n_rows,
                                 int64_t width, int64_t k,
                                 float eta, float lambda,
-                                float* w0_inout, float* w, float* V) {
+                                float* w0_inout, float* w, float* V,
+                                uint8_t* touched) {
     float w0 = *w0_inout;
     double sumVfX[64];  // k <= 64 (reference default 5)
     if (k > 64) return -1;
@@ -770,6 +782,7 @@ int64_t hm_fm_reference_rowloop(const int32_t* idx, const float* val,
                 const double h = xi * (sumVfX[f] - (double)vi[f] * xi);
                 vi[f] -= eta * ((float)(dloss * h) + 2.f * lambda * vi[f]);
             }
+            if (touched) touched[i] = 1;
         }
     }
     *w0_inout = w0;

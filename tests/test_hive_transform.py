@@ -177,6 +177,108 @@ def test_mf_emission():
     assert len(json.loads(pu_rows[0][1])) == 4
 
 
+def test_train_ffm_blob_row_and_predict_ffm(tmp_path):
+    """train_ffm's emission carries the complete model as a base91 blob
+    row (feature -2); predict_ffm scores the full pairwise model from it
+    with framework parity."""
+    rng = np.random.RandomState(11)
+    rows, labels = [], []
+    for _ in range(200):
+        idx = rng.choice(32, size=5, replace=False)
+        rows.append(ITEM_SEP.join(f"{j % 4}:{j}:1" for j in idx))
+        labels.append(1.0 if idx.sum() > 75 else -1.0)
+    train_in = "".join(f"{r}\t{y}\n" for r, y in zip(rows, labels))
+    proc = run_bridge(["train_ffm", "-feature_hashing", "8", "-factors",
+                       "3"], train_in)
+    out_rows = [line.split("\t") for line in proc.stdout.splitlines()]
+    assert all(len(r) == 3 for r in out_rows)
+    blob_rows = [r for r in out_rows if r[0] == "-2"]
+    assert len(blob_rows) == 1 and blob_rows[0][2] != "\\N"
+
+    model_file = tmp_path / "ffm.tsv"
+    model_file.write_text(proc.stdout)
+    test_in = "".join(f"{i}\t{r}\n" for i, r in enumerate(rows[:40]))
+    pred = run_bridge(["predict_ffm", "-loadmodel", str(model_file)],
+                      test_in)
+    scores = np.array([float(line.split("\t")[1])
+                       for line in pred.stdout.splitlines()])
+
+    from hivemall_tpu.models.ffm import train_ffm
+
+    fw = train_ffm([r.split(ITEM_SEP) for r in rows], labels,
+                   "-feature_hashing 8 -factors 3")
+    fw_scores = np.asarray(fw.predict([r.split(ITEM_SEP)
+                                       for r in rows[:40]]))
+    # blob values are half-float compressed (the reference's recipe)
+    np.testing.assert_allclose(scores, fw_scores, rtol=5e-3, atol=5e-3)
+
+
+def test_predict_multiclass_roundtrip(tmp_path):
+    rng = np.random.RandomState(6)
+    rows, labels = [], []
+    for _ in range(300):
+        c = rng.randint(3)
+        idx = [c * 8 + int(j) for j in rng.choice(8, size=3, replace=False)]
+        rows.append(ITEM_SEP.join(f"{j}:1" for j in idx))
+        labels.append(f"class{c}")
+    train_in = "".join(f"{r}\t{lab}\n" for r, lab in zip(rows, labels))
+    proc = run_bridge(["train_multiclass_perceptron", "-dims", "24"],
+                      train_in)
+    model_file = tmp_path / "mc.tsv"
+    model_file.write_text(proc.stdout)
+    test_in = "".join(f"r{i}\t{r}\n" for i, r in enumerate(rows[:60]))
+    pred = run_bridge(["predict_multiclass", "-loadmodel", str(model_file)],
+                      test_in)
+    scored = [line.split("\t") for line in pred.stdout.splitlines()]
+    assert len(scored) == 60 and all(len(r) == 3 for r in scored)
+    acc = np.mean([r[1] == lab for r, lab in zip(scored, labels[:60])])
+    assert acc > 0.9, acc
+
+
+def test_predict_forest_roundtrip(tmp_path):
+    rng = np.random.RandomState(8)
+    X = rng.rand(300, 5)
+    y = (X[:, 0] > 0.5).astype(int)
+    train_in = "".join(
+        ITEM_SEP.join(f"{v:.6f}" for v in X[i]) + f"\t{int(y[i])}\n"
+        for i in range(len(y)))
+    proc = run_bridge(["train_randomforest_classifier", "-trees", "8",
+                       "-seed", "3"], train_in)
+    model_file = tmp_path / "rf.tsv"
+    model_file.write_text(proc.stdout)
+    test_in = "".join(
+        f"r{i}\t" + ITEM_SEP.join(f"{v:.6f}" for v in X[i]) + "\n"
+        for i in range(100))
+    pred = run_bridge(["predict_forest", "-loadmodel", str(model_file)],
+                      test_in)
+    scored = [line.split("\t") for line in pred.stdout.splitlines()]
+    votes = np.array([int(r[1]) for r in scored])
+    assert np.mean(votes == y[:100]) > 0.9
+
+
+def test_train_arow_native_scan_through_bridge(tmp_path):
+    """The host fast path drives end to end through the TRANSFORM framing."""
+    from hivemall_tpu import native
+
+    if not native.available():
+        import pytest as _pytest
+
+        _pytest.skip("native lib not built")
+    _, rows = _dataset(n=200, seed=9)
+    stdin_text = "".join(
+        ITEM_SEP.join(f"{j}:1" for j in idx) + f"\t{y}\n" for idx, y in rows)
+    fast = run_bridge(["train_arow", "-dims", "64", "-native_scan"],
+                      stdin_text)
+    plain = run_bridge(["train_arow", "-dims", "64"], stdin_text)
+    got = {r.split("\t")[0]: float(r.split("\t")[1])
+           for r in fast.stdout.splitlines()}
+    want = {r.split("\t")[0]: float(r.split("\t")[1])
+            for r in plain.stdout.splitlines()}
+    assert got.keys() == want.keys()
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-4
+
+
 def test_gbt_refused_and_unknown_subcommand():
     proc = run_bridge(["train_gradient_tree_boosting_classifier"],
                       "0:1\t1\n", check=False)

@@ -278,3 +278,139 @@ class TestParseFeaturesBulk:
         np.testing.assert_array_equal(
             out[0][0], [mhash("日本語", 1 << 20), mhash("ペン", 1 << 20)])
         np.testing.assert_allclose(out[1][0], [2.0, 1.0])
+
+
+class TestNativeScanBackend:
+    """`-native_scan`: AROW epochs through the C row loop as an execution
+    backend (the bench-anchor loop shipped as a host fast path)."""
+
+    def _data(self, n=400, d=64, seed=0):
+        rng = np.random.RandomState(seed)
+        w_true = rng.randn(d)
+        idx = [rng.choice(d, size=6, replace=False) for _ in range(n)]
+        val = [np.ones(6, np.float32) for _ in range(n)]
+        y = np.array([1.0 if w_true[i].sum() > 0 else -1.0 for i in idx])
+        return idx, val, y
+
+    def test_parity_with_engine_scan(self):
+        import hivemall_tpu.native as native
+        from hivemall_tpu.models.classifier import train_arow
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        idx, val, y = self._data()
+        ref = train_arow((idx, val), y, "-dims 64")
+        got = train_arow((idx, val), y, "-dims 64 -native_scan")
+        np.testing.assert_allclose(np.asarray(got.state.weights),
+                                   np.asarray(ref.state.weights),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(got.state.covars),
+                                   np.asarray(ref.state.covars),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(got.state.touched),
+                                      np.asarray(ref.state.touched))
+        # served predictions match too
+        np.testing.assert_allclose(
+            got.predict((idx[:50], val[:50])),
+            ref.predict((idx[:50], val[:50])), rtol=1e-4, atol=1e-5)
+
+    def test_warm_start_and_epochs(self):
+        import hivemall_tpu.native as native
+        from hivemall_tpu.models.classifier import train_arow
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        idx, val, y = self._data(seed=1)
+        ref = train_arow((idx, val), y, "-dims 64 -iters 3 -disable_cv")
+        got = train_arow((idx, val), y,
+                         "-dims 64 -iters 3 -disable_cv -native_scan")
+        np.testing.assert_allclose(np.asarray(got.state.weights),
+                                   np.asarray(ref.state.weights),
+                                   rtol=1e-4, atol=1e-5)
+        w0 = np.asarray(ref.state.weights)
+        c0 = np.asarray(ref.state.covars)
+        warm = train_arow((idx, val), y, "-dims 64 -native_scan",
+                          initial_weights=w0, initial_covars=c0)
+        assert not np.allclose(np.asarray(warm.state.weights), w0)
+        # a warm-start-only feature that training never updates must STAY
+        # in the model emission (touched mask = monotone flags OR the
+        # warm-start mask, like the engine path — advisor-caught case)
+        w_seed = np.zeros(64, np.float32)
+        w_seed[63] = 1.5  # feature 63 never appears in idx? force it:
+        idx2 = [np.asarray(i) % 60 for i in idx]  # confine data to [0, 60)
+        warm2 = train_arow((idx2, val), y, "-dims 64 -native_scan",
+                           initial_weights=w_seed)
+        feats, w_emit, _ = warm2.model_rows()
+        assert 63 in set(np.asarray(feats).tolist())
+        assert w_emit[list(np.asarray(feats)).index(63)] == 1.5
+
+    def test_refusals(self):
+        import hivemall_tpu.native as native
+        from hivemall_tpu.models.classifier import train_arow, train_perceptron
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        idx, val, y = self._data(n=20)
+        with pytest.raises(ValueError, match="train_arow only"):
+            train_perceptron((idx, val), y, "-dims 64 -native_scan")
+        with pytest.raises(ValueError, match="mini_batch"):
+            train_arow((idx, val), y, "-dims 64 -mini_batch 8 -native_scan")
+
+
+class TestNativeFMScanBackend:
+    """`-native_scan` for train_fm: the train_fm anchor loop as a host
+    execution backend (classification + fixed -eta + no -adareg scan)."""
+
+    def _data(self, n=400, d=64, seed=0):
+        rng = np.random.RandomState(seed)
+        w_true = rng.randn(d)
+        idx = [rng.choice(d, size=6, replace=False) for _ in range(n)]
+        val = [np.ones(6, np.float32) for _ in range(n)]
+        y = np.array([1.0 if w_true[i].sum() > 0 else -1.0 for i in idx])
+        return idx, val, y
+
+    OPTS = "-dims 64 -factors 4 -classification -eta 0.05 -iters 2 -disable_cv"
+
+    def test_parity_with_engine_scan(self):
+        import hivemall_tpu.native as native
+        from hivemall_tpu.models.fm import train_fm
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        idx, val, y = self._data()
+        ref = train_fm((idx, val), y, self.OPTS)
+        got = train_fm((idx, val), y, self.OPTS + " -native_scan")
+        # the C loop keeps the reference's f64 accumulators (the JVM uses
+        # double for predict sums) while the engine is f32 TPU-native;
+        # sequential feedback amplifies that to ~1e-3 over hundreds of
+        # rows — parity is to accumulator precision, decisions identical
+        np.testing.assert_allclose(np.asarray(got.state.w),
+                                   np.asarray(ref.state.w), atol=5e-3)
+        np.testing.assert_allclose(np.asarray(got.state.v),
+                                   np.asarray(ref.state.v), atol=5e-3)
+        # the GLOBAL bias must match too (the availability probe once
+        # shifted it by +eta/2 before training — advisor-caught)
+        assert abs(float(got.state.w0) - float(ref.state.w0)) < 5e-3
+        np.testing.assert_array_equal(np.asarray(got.state.touched),
+                                      np.asarray(ref.state.touched))
+        p_ref = np.asarray(ref.predict((idx, val)))
+        p_nat = np.asarray(got.predict((idx, val)))
+        np.testing.assert_allclose(p_nat, p_ref, atol=2e-2)
+        assert np.all(np.sign(p_nat) == np.sign(p_ref))
+
+    def test_refusals(self):
+        import hivemall_tpu.native as native
+        from hivemall_tpu.models.fm import train_fm
+
+        if not native.available():
+            pytest.skip("native lib not built")
+        idx, val, y = self._data(n=20)
+        # invscaling eta (the default) is outside the C loop's envelope
+        with pytest.raises(ValueError, match="fixed -eta"):
+            train_fm((idx, val), y,
+                     "-dims 64 -classification -native_scan")
+        with pytest.raises(ValueError, match="classification"):
+            train_fm((idx, val), y, "-dims 64 -eta 0.05 -native_scan")
+        with pytest.raises(ValueError, match="adareg"):
+            train_fm((idx, val), y, "-dims 64 -classification -eta 0.05 "
+                                    "-adareg -native_scan")
