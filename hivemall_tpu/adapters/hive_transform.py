@@ -107,12 +107,6 @@ def _run_trainer(trainer: str, options: Optional[str], src: IO[str],
     fn = get_function(trainer)
     is_forest = trainer.startswith(("train_randomforest",
                                     "train_gradient_tree"))
-    if trainer.startswith("train_gradient_tree"):
-        print(f"{trainer}: GBT models have no row emission (the reference "
-              "serves them framework-side too); train through the framework "
-              "API instead", file=sys.stderr)
-        return 2
-
     if trainer in _MF_TRAINERS:
         return _run_mf_trainer(trainer, fn, options, src, out)
 
@@ -177,7 +171,15 @@ def _run_mf_trainer(trainer: str, fn, options: Optional[str], src: IO[str],
 def _emit_model_rows(trainer: str, model, out: IO[str]) -> None:
     from ..models.ffm import TrainedFFMModel
     from ..models.fm import TrainedFMModel
-    from ..models.trees.forest import TrainedForest
+    from ..models.trees.forest import TrainedForest, TrainedGBT
+
+    if isinstance(model, TrainedGBT):
+        # per-(round, class) rows, the reference's per-round forward
+        # (GradientTreeBoostingClassifierUDTF.java:525-546)
+        for m, c, mt, text, ic, sh, imp, oob in model.model_rows():
+            _emit(out, int(m), int(c), str(mt), text, float(ic),
+                  float(sh), json.dumps(imp), oob)
+        return
 
     if isinstance(model, TrainedFMModel):
         w0, feats, w, v = model.model_rows()
@@ -258,6 +260,8 @@ def _run_predict_linear(argv: Sequence[str], src: IO[str],
             if not line.strip():
                 continue
             cols = _cells(line)
+            if cols[1] is None:
+                continue  # e.g. train_ffm's feature -2 blob row (NULL wi)
             weights[int(cols[0])] = float(cols[1])  # covar column ignored
 
     from ..utils.feature import parse_feature
@@ -296,6 +300,8 @@ def _run_predict_fm(argv: Sequence[str], src: IO[str], out: IO[str]) -> int:
                 continue
             cols = _cells(line)
             fid = int(cols[0])
+            if cols[1] is None:
+                continue  # e.g. train_ffm's feature -2 blob row (NULL wi)
             if fid == -1:
                 w0 = float(cols[1])
                 continue

@@ -387,6 +387,25 @@ def _materialize_forest(q, model, model_table: str) -> None:
          for mid, mtype, model_text, imp, oe, ot in model.model_rows()))
 
 
+def _materialize_gbt(q, model, model_table: str) -> None:
+    """One row per (boosting round, class tree) — the reference's per-round
+    forward flattened relationally (GradientTreeBoostingClassifierUDTF
+    .java:525-546; the per-class models array becomes a cls column). Score
+    binary in SQL with
+    `MAX(intercept) + MAX(shrinkage) * SUM(tree_predict(model_type,
+    pred_model, features))` per row; multiclass per (row, cls) +
+    max_label."""
+    q.execute(f"CREATE TABLE {model_table} (iter INTEGER, cls INTEGER, "
+              "model_type TEXT, pred_model TEXT, intercept REAL, "
+              "shrinkage REAL, var_importance TEXT, oob_error_rate REAL, "
+              "PRIMARY KEY (iter, cls))")
+    q.executemany(
+        f"INSERT INTO {model_table} VALUES (?,?,?,?,?,?,?,?)",
+        ((int(m), int(c), str(mt), text, float(ic), float(sh),
+          json.dumps(imp), oob)
+         for m, c, mt, text, ic, sh, imp, oob in model.model_rows()))
+
+
 def _materialize_multiclass(q, model, model_table: str) -> None:
     """(label, feature, weight[, covar]) — the per-label close() emission
     (ref: MulticlassOnlineClassifierUDTF close)."""
@@ -421,10 +440,13 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
     The table shape follows the trainer family, exactly the reference's
     per-family emissions: linear `(feature, weight[, covar])`; FM
     `(feature, wi, vif JSON)` with w0 on feature -1 (score in SQL with the
-    fm_predict aggregate); FFM linear part only (V stays framework-side,
-    like the reference's opaque blob); multiclass
-    `(label, feature, weight[, covar])` (score with SUM(weight*value) per
-    (row,label) + max_label)."""
+    fm_predict aggregate); FFM linear rows + the complete compressed blob
+    (scored by ffm_predict); multiclass `(label, feature, weight[, covar])`
+    (score with SUM(weight*value) per (row,label) + max_label); forests
+    per-tree rows (tree_predict + rf_ensemble); GBT per-(round, class)
+    rows (intercept + shrinkage * SUM(tree_predict)) — the reference
+    forwards GBT per round too
+    (GradientTreeBoostingClassifierUDTF.java:525-546)."""
     if model_table is not None:
         _check_ident(model_table)
     if warm_start_table is not None:
@@ -432,13 +454,6 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
     fn = get_function(trainer)
     is_forest = trainer.startswith(("train_randomforest",
                                     "train_gradient_tree"))
-    # fail fast BEFORE the (expensive) training run: GBT has no SQL row
-    # emission (the reference serves it framework-side too)
-    if model_table is not None and trainer.startswith("train_gradient_tree"):
-        raise ValueError(
-            f"{trainer} models have no SQL row emission (the reference "
-            "serves them framework-side too); pass model_table=None and "
-            "predict on the returned model object")
     rows = conn.execute(src_query).fetchall()
     # forests consume dense array<double> rows (the reference's RF input),
     # every other family consumes "name:value" feature lists
@@ -499,7 +514,7 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
 
     from ..models.ffm import TrainedFFMModel
     from ..models.fm import TrainedFMModel
-    from ..models.trees.forest import TrainedForest
+    from ..models.trees.forest import TrainedForest, TrainedGBT
 
     # resolve the family's materializer BEFORE dropping anything so a
     # refused call leaves any existing model table intact
@@ -507,6 +522,8 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
         materialize = _materialize_fm
     elif isinstance(model, TrainedFFMModel):
         materialize = _materialize_ffm
+    elif isinstance(model, TrainedGBT):
+        materialize = _materialize_gbt
     elif isinstance(model, TrainedForest):
         materialize = _materialize_forest
     elif hasattr(model, "label_vocab"):  # multiclass family
@@ -515,9 +532,8 @@ def train(conn: sqlite3.Connection, trainer: str, src_query: str,
         materialize = _materialize_linear
     else:
         raise ValueError(
-            f"{trainer} models have no SQL row emission (the reference "
-            "serves them framework-side too); pass model_table=None and "
-            "predict on the returned model object")
+            f"{trainer} models have no SQL materialization here; pass "
+            "model_table=None and predict on the returned model object")
     q = conn.cursor()
     q.execute(f"DROP TABLE IF EXISTS {model_table}")
     # a previous train_ffm into this name also left {model_table}_blob;

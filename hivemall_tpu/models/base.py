@@ -66,9 +66,9 @@ def base_options() -> Options:
           "Use the VMEM-resident Pallas backend for exact scan mode "
           "(models that fit on-chip; kernels/linear_scan.py)")
     o.add("native_scan", None, False,
-          "Run exact scan epochs through the native C row loop "
-          "(train_arow only; the host fast path for accelerator-less "
-          "mappers, e.g. the Hive TRANSFORM bridge)")
+          "Run exact scan epochs through the native C row loop — the "
+          "host fast path for accelerator-less mappers (train_arow: any "
+          "options; train_fm: -classification with a fixed -eta)")
     return o
 
 

@@ -279,6 +279,27 @@ class TrainedGBT:
             return self.classes[(s[:, 0] > 0).astype(int)]
         return self.classes[np.argmax(s, axis=1)]
 
+    def model_rows(self, output: str = "opscode"):
+        """One row per (boosting round, class tree): (iter, cls,
+        model_type, pred_model, intercept, shrinkage, var_importance,
+        oob_error_rate). The reference forwards (m, type, models[],
+        intercept, shrinkage, importance, oobErrorRate) per round
+        (GradientTreeBoostingClassifierUDTF.java:525-546); the per-class
+        models ARRAY column flattens to one relational row per class
+        here. oob_error_rate is None — the subsample OOB estimate is not
+        tracked (documented deviation). Exported programs evaluate on RAW
+        feature vectors (bins embedded), so SQL scoring is
+        intercept + shrinkage * SUM(tree_predict(...)) over rounds."""
+        rows = []
+        for m, round_trees in enumerate(self.trees, start=1):
+            for cls, tree in enumerate(round_trees):
+                mtype, text = _export(tree, self.bins, output)
+                imp = _var_importance(tree, len(self.bins)).tolist()
+                rows.append((m, cls, mtype, text,
+                             float(self.intercept[cls]),
+                             float(self.shrinkage), imp, None))
+        return rows
+
 
 def train_gradient_tree_boosting_classifier(X, labels, options: Optional[str] = None,
                                             row_shard=None) -> TrainedGBT:

@@ -279,10 +279,20 @@ def test_train_arow_native_scan_through_bridge(tmp_path):
         assert abs(got[k] - want[k]) < 1e-4
 
 
-def test_gbt_refused_and_unknown_subcommand():
-    proc = run_bridge(["train_gradient_tree_boosting_classifier"],
-                      "0:1\t1\n", check=False)
-    assert proc.returncode == 2
+def test_gbt_emission_and_unknown_subcommand():
+    rng = np.random.RandomState(12)
+    X = rng.rand(200, 4)
+    y = (X[:, 0] > 0.5).astype(int)
+    stdin_text = "".join(
+        ITEM_SEP.join(f"{v:.6f}" for v in X[i]) + f"\t{int(y[i])}\n"
+        for i in range(len(y)))
+    proc = run_bridge(["train_gradient_tree_boosting_classifier", "-trees",
+                       "4", "-iters", "4", "-seed", "2"], stdin_text)
+    out_rows = [line.split("\t") for line in proc.stdout.splitlines()]
+    assert len(out_rows) == 4  # one row per binary boosting round
+    assert all(len(r) == 8 for r in out_rows)
+    assert [r[0] for r in out_rows] == ["1", "2", "3", "4"]
+
     proc = run_bridge(["sigmoid"], "", check=False)
     assert proc.returncode == 2
     assert "unknown subcommand" in proc.stderr

@@ -437,15 +437,26 @@ def test_forest_sql_flow(conn):
     np.testing.assert_array_equal(sql_pred, fw_pred)
     assert np.mean(sql_pred == y) > 0.85
 
-    # GBT has no SQL row emission: explicit refusal + train-only mode works
-    with pytest.raises(ValueError, match="model_table=None"):
-        hsql.train(conn, "train_gradient_tree_boosting_classifier",
-                   "SELECT features, label FROM fx",
-                   options="-trees 4 -iters 3")
+    # GBT materializes per-(round, class) rows like the reference's
+    # per-round forward, and scores in SQL:
+    # intercept + shrinkage * SUM(tree_predict) (binary)
     gbt = hsql.train(conn, "train_gradient_tree_boosting_classifier",
                      "SELECT features, label FROM fx",
-                     options="-trees 4 -iters 3", model_table=None)
-    assert np.mean(gbt.predict(X) == y) > 0.8
+                     options="-trees 6 -iters 6", model_table="gbt_model")
+    cols = [r[1] for r in conn.execute("PRAGMA table_info(gbt_model)")]
+    assert cols == ["iter", "cls", "model_type", "pred_model", "intercept",
+                    "shrinkage", "var_importance", "oob_error_rate"]
+    got = conn.execute("""
+        SELECT fx.id,
+               MAX(m.intercept) + MAX(m.shrinkage) *
+                 SUM(tree_predict(m.model_type, m.pred_model, fx.features))
+        FROM fx CROSS JOIN gbt_model m WHERE m.cls = 0
+        GROUP BY fx.id ORDER BY fx.id""").fetchall()
+    sql_scores = np.array([s for _, s in got])
+    fw_scores = gbt.decision_function(X)[:, 0]
+    np.testing.assert_allclose(sql_scores, fw_scores, rtol=1e-5, atol=1e-6)
+    sql_pred = (sql_scores > 0).astype(int)
+    np.testing.assert_array_equal(sql_pred, gbt.predict(X))
 
 
 def test_regression_forest_sql_scoring(conn):
@@ -475,18 +486,23 @@ def test_regression_forest_sql_scoring(conn):
 
 
 def test_refused_train_preserves_existing_model_table(conn):
-    """A refused materialization must not drop the caller's table."""
+    """A refused run must not drop the caller's table (every refusal path
+    raises BEFORE the DROP: identifier validation, warm-start checks)."""
     _make_dataset(conn)
     hsql.train(conn, "train_arow", "SELECT features, label FROM train",
                options="-dims 32", model_table="keep_me")
     n_before = conn.execute("SELECT COUNT(*) FROM keep_me").fetchone()[0]
-    conn.execute("CREATE TABLE fx2 (features TEXT, label INTEGER)")
-    conn.executemany("INSERT INTO fx2 VALUES (?,?)",
-                     [("0.1 0.9", 0), ("0.9 0.1", 1)] * 20)
-    with pytest.raises(ValueError, match="model_table=None"):
-        hsql.train(conn, "train_gradient_tree_boosting_classifier",
-                   "SELECT features, label FROM fx2",
-                   options="-trees 2 -iters 2", model_table="keep_me")
+    # warm-start refusal: smaller -dims than the table was trained at
+    with pytest.raises(ValueError, match="feature ids outside"):
+        hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+                   options="-dims 8", model_table="keep_me",
+                   warm_start_table="keep_me")
+    assert conn.execute("SELECT COUNT(*) FROM keep_me").fetchone()[0] \
+        == n_before
+    # identifier refusal
+    with pytest.raises(ValueError):
+        hsql.train(conn, "train_arow", "SELECT features, label FROM train",
+                   options="-dims 32", model_table="keep_me; DROP TABLE x")
     assert conn.execute("SELECT COUNT(*) FROM keep_me").fetchone()[0] \
         == n_before
 
