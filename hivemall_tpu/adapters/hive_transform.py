@@ -45,6 +45,10 @@ materializations):
 - ``predict_forest -loadmodel <file> [-regression]`` (rowid, dense
   features) -> (rowid, vote) over a forest TSV model (tree_predict +
   rf_ensemble)
+- ``predict_gbt -loadmodel <file>``                (rowid, dense
+  features) -> (rowid, label, score) over a GBT TSV model
+  (intercept + shrinkage * summed rounds; binary sign / multiclass
+  argmax)
 
 Run as ``hivemall-tpu <subcommand> ...`` (bin/ shim) or
 ``python -m hivemall_tpu.adapters.hive_transform <subcommand> ...``.
@@ -175,10 +179,12 @@ def _emit_model_rows(trainer: str, model, out: IO[str]) -> None:
 
     if isinstance(model, TrainedGBT):
         # per-(round, class) rows, the reference's per-round forward
-        # (GradientTreeBoostingClassifierUDTF.java:525-546)
-        for m, c, mt, text, ic, sh, imp, oob in model.model_rows():
+        # (GradientTreeBoostingClassifierUDTF.java:525-546) + a classes
+        # JSON column (this trainer accepts arbitrary labels where the
+        # reference requires 0..K-1 indices)
+        for m, c, mt, text, ic, sh, imp, oob, vocab in model.model_rows():
             _emit(out, int(m), int(c), str(mt), text, float(ic),
-                  float(sh), json.dumps(imp), oob)
+                  float(sh), json.dumps(imp), oob, vocab)
         return
 
     if isinstance(model, TrainedFMModel):
@@ -466,6 +472,60 @@ def _run_predict_forest(argv: Sequence[str], src: IO[str],
     return 0
 
 
+def _run_predict_gbt(argv: Sequence[str], src: IO[str], out: IO[str]) -> int:
+    """(rowid, dense features) -> (rowid, label, score) over a GBT model
+    TSV (the per-(round, class) train_gradient_tree_boosting_classifier
+    emission): score_cls = intercept + shrinkage * sum over rounds of the
+    class tree's leaf; binary label = score>0, multiclass = argmax."""
+    model_path, _ = _parse_predict_args(argv)
+    from ..models.trees.predict import compile_tree
+
+    per_cls: dict = {}
+    vocab = None
+    with open(model_path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            c = _cells(line)
+            cls = int(c[1])
+            entry = per_cls.setdefault(
+                cls, {"intercept": float(c[4]), "shrinkage": float(c[5]),
+                      "trees": []})
+            entry["trees"].append(compile_tree(c[2], c[3]))
+            if vocab is None and len(c) > 8 and c[8] is not None:
+                vocab = json.loads(c[8])
+    if not per_cls:
+        print("predict_gbt: empty model file", file=sys.stderr)
+        return 2
+    classes = sorted(per_cls)
+
+    def to_label(index: int):
+        # the emission's classes column maps score indices back to the
+        # trained labels (arbitrary here; the reference requires 0..K-1
+        # so its emission needs no vocabulary)
+        return vocab[index] if vocab is not None else index
+
+    for line in src:
+        if not line.strip():
+            continue
+        cols = _cells(line)
+        if len(cols) < 2 or cols[1] is None:
+            continue
+        x = _dense_list(cols[1])
+        scores = {}
+        for cls in classes:
+            e = per_cls[cls]
+            scores[cls] = e["intercept"] + e["shrinkage"] * sum(
+                t(x) for t in e["trees"])
+        if len(classes) == 1:  # binary: one tree stack, sign decides
+            label = to_label(int(scores[classes[0]] > 0))
+            _emit(out, cols[0], label, scores[classes[0]])
+        else:
+            best = max(classes, key=lambda cl: scores[cl])
+            _emit(out, cols[0], to_label(best), scores[best])
+    return 0
+
+
 # ----------------------------------------------------------------------- CLI
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -485,13 +545,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return _run_predict_forest(rest, src, out)
     if cmd == "predict_ffm":
         return _run_predict_ffm(rest, src, out)
+    if cmd == "predict_gbt":
+        return _run_predict_gbt(rest, src, out)
 
     from ..sql.registry import REGISTRY
 
     is_trainer = cmd.startswith("train_") or cmd == "logress"
     if cmd not in REGISTRY or not is_trainer:
         print(f"unknown subcommand {cmd!r}; expected a train_* registry "
-              "name or predict_{linear,fm,ffm,multiclass,forest}",
+              "name or predict_{linear,fm,ffm,multiclass,forest,gbt}",
               file=sys.stderr)
         return 2
     options = " ".join(rest) if rest else None

@@ -398,12 +398,13 @@ def _materialize_gbt(q, model, model_table: str) -> None:
     q.execute(f"CREATE TABLE {model_table} (iter INTEGER, cls INTEGER, "
               "model_type TEXT, pred_model TEXT, intercept REAL, "
               "shrinkage REAL, var_importance TEXT, oob_error_rate REAL, "
-              "PRIMARY KEY (iter, cls))")
+              "classes TEXT, PRIMARY KEY (iter, cls))")
     q.executemany(
-        f"INSERT INTO {model_table} VALUES (?,?,?,?,?,?,?,?)",
+        f"INSERT INTO {model_table} VALUES (?,?,?,?,?,?,?,?,?)",
         ((int(m), int(c), str(mt), text, float(ic), float(sh),
-          json.dumps(imp), oob)
-         for m, c, mt, text, ic, sh, imp, oob in model.model_rows()))
+          json.dumps(imp), oob, vocab)
+         for m, c, mt, text, ic, sh, imp, oob, vocab
+         in model.model_rows()))
 
 
 def _materialize_multiclass(q, model, model_table: str) -> None:

@@ -282,14 +282,24 @@ class TrainedGBT:
     def model_rows(self, output: str = "opscode"):
         """One row per (boosting round, class tree): (iter, cls,
         model_type, pred_model, intercept, shrinkage, var_importance,
-        oob_error_rate). The reference forwards (m, type, models[],
-        intercept, shrinkage, importance, oobErrorRate) per round
-        (GradientTreeBoostingClassifierUDTF.java:525-546); the per-class
-        models ARRAY column flattens to one relational row per class
-        here. oob_error_rate is None — the subsample OOB estimate is not
-        tracked (documented deviation). Exported programs evaluate on RAW
-        feature vectors (bins embedded), so SQL scoring is
+        oob_error_rate, classes). The reference forwards (m, type,
+        models[], intercept, shrinkage, importance, oobErrorRate) per
+        round (GradientTreeBoostingClassifierUDTF.java:525-546); the
+        per-class models ARRAY column flattens to one relational row per
+        class here. Deviations, both documented: oob_error_rate is None
+        (the subsample OOB estimate is not tracked), and a `classes` JSON
+        column carries the label vocabulary — the reference needs none
+        because it REQUIRES labels to be 0..K-1 indices
+        (GradientTreeBoostingClassifierUDTF.java:301-303 rejects negative
+        labels); this trainer accepts arbitrary labels, so predictions
+        from rows must map score indices back through `classes`.
+        Exported programs evaluate on RAW feature vectors (bins
+        embedded), so SQL scoring is
         intercept + shrinkage * SUM(tree_predict(...)) over rounds."""
+        import json as _json
+
+        cls_vocab = _json.dumps([c.item() if hasattr(c, "item") else c
+                                 for c in self.classes])
         rows = []
         for m, round_trees in enumerate(self.trees, start=1):
             for cls, tree in enumerate(round_trees):
@@ -297,7 +307,7 @@ class TrainedGBT:
                 imp = _var_importance(tree, len(self.bins)).tolist()
                 rows.append((m, cls, mtype, text,
                              float(self.intercept[cls]),
-                             float(self.shrinkage), imp, None))
+                             float(self.shrinkage), imp, None, cls_vocab))
         return rows
 
 

@@ -290,12 +290,52 @@ def test_gbt_emission_and_unknown_subcommand():
                        "4", "-iters", "4", "-seed", "2"], stdin_text)
     out_rows = [line.split("\t") for line in proc.stdout.splitlines()]
     assert len(out_rows) == 4  # one row per binary boosting round
-    assert all(len(r) == 8 for r in out_rows)
+    assert all(len(r) == 9 for r in out_rows)
     assert [r[0] for r in out_rows] == ["1", "2", "3", "4"]
+    assert json.loads(out_rows[0][8]) == [0, 1]  # label vocabulary
 
     proc = run_bridge(["sigmoid"], "", check=False)
     assert proc.returncode == 2
     assert "unknown subcommand" in proc.stderr
+
+
+def test_predict_gbt_roundtrip(tmp_path):
+    """GBT trained through the bridge scores through predict_gbt with
+    framework decision parity — with {-1, 1} labels, so the classes
+    vocabulary mapping is exercised (advisor-caught: without it the
+    bridge emitted class INDICES, silently diverging from the
+    framework's labels)."""
+    rng = np.random.RandomState(14)
+    X = rng.rand(240, 4)
+    y = np.where(X[:, 0] > 0.5, 1, -1)
+    train_in = "".join(
+        ITEM_SEP.join(f"{v:.6f}" for v in X[i]) + f"\t{int(y[i])}\n"
+        for i in range(len(y)))
+    proc = run_bridge(["train_gradient_tree_boosting_classifier", "-trees",
+                       "6", "-iters", "6", "-seed", "5"], train_in)
+    model_file = tmp_path / "gbt.tsv"
+    model_file.write_text(proc.stdout)
+    test_in = "".join(
+        f"r{i}\t" + ITEM_SEP.join(f"{v:.6f}" for v in X[i]) + "\n"
+        for i in range(80))
+    pred = run_bridge(["predict_gbt", "-loadmodel", str(model_file)],
+                      test_in)
+    scored = [line.split("\t") for line in pred.stdout.splitlines()]
+    assert len(scored) == 80 and all(len(r) == 3 for r in scored)
+
+    from hivemall_tpu.models.trees.forest import \
+        train_gradient_tree_boosting_classifier
+
+    fw = train_gradient_tree_boosting_classifier(
+        X, y, "-trees 6 -iters 6 -seed 5")
+    fw_pred = fw.predict(X[:80])
+    fw_scores = fw.decision_function(X[:80])[:, 0]
+    # the bridge parses TSV labels as floats, so its vocabulary is
+    # [-1.0, 1.0] where the direct int-label call yields [-1, 1]
+    got_labels = np.array([int(float(r[1])) for r in scored])
+    got_scores = np.array([float(r[2]) for r in scored])
+    np.testing.assert_array_equal(got_labels, fw_pred)
+    np.testing.assert_allclose(got_scores, fw_scores, rtol=1e-5, atol=1e-6)
 
 
 def test_bin_shim_exists_and_is_executable():

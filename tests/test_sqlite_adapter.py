@@ -445,7 +445,13 @@ def test_forest_sql_flow(conn):
                      options="-trees 6 -iters 6", model_table="gbt_model")
     cols = [r[1] for r in conn.execute("PRAGMA table_info(gbt_model)")]
     assert cols == ["iter", "cls", "model_type", "pred_model", "intercept",
-                    "shrinkage", "var_importance", "oob_error_rate"]
+                    "shrinkage", "var_importance", "oob_error_rate",
+                    "classes"]
+    import json as _json
+
+    (vocab,) = conn.execute(
+        "SELECT DISTINCT classes FROM gbt_model").fetchone()
+    assert _json.loads(vocab) == [0, 1]
     got = conn.execute("""
         SELECT fx.id,
                MAX(m.intercept) + MAX(m.shrinkage) *
@@ -483,6 +489,37 @@ def test_regression_forest_sql_scoring(conn):
     np.testing.assert_allclose(sql_pred, fw_pred, rtol=1e-6, atol=1e-6)
     # float leaves, not int-truncated
     assert np.any(np.abs(sql_pred - np.round(sql_pred)) > 1e-3)
+
+
+def test_multiclass_gbt_sql_scoring(conn):
+    """Multiclass GBT in SQL: per-(row, cls) summed scores + max_label —
+    same plan shape as linear multiclass, over the per-(round, class)
+    emission."""
+    rng = np.random.RandomState(13)
+    X = rng.rand(240, 5)
+    y = (X[:, 0] > 0.6).astype(int) + (X[:, 1] > 0.5).astype(int)  # 3 cls
+    conn.execute("CREATE TABLE g3 (id INTEGER, features TEXT, label INT)")
+    conn.executemany(
+        "INSERT INTO g3 VALUES (?,?,?)",
+        [(i, " ".join(f"{v:.6f}" for v in X[i]), int(y[i]))
+         for i in range(len(y))])
+    gbt = hsql.train(conn, "train_gradient_tree_boosting_classifier",
+                     "SELECT features, label FROM g3",
+                     options="-trees 6 -iters 6 -seed 4",
+                     model_table="gbt3")
+    (ncls,) = conn.execute("SELECT COUNT(DISTINCT cls) FROM gbt3").fetchone()
+    assert ncls == 3
+    got = conn.execute("""
+        WITH per_cls AS (
+          SELECT g3.id AS id, m.cls AS cls,
+                 MAX(m.intercept) + MAX(m.shrinkage) *
+                   SUM(tree_predict(m.model_type, m.pred_model, g3.features))
+                 AS score
+          FROM g3 CROSS JOIN gbt3 m GROUP BY g3.id, m.cls)
+        SELECT id, max_label(score, cls) FROM per_cls
+        GROUP BY id ORDER BY id""").fetchall()
+    sql_pred = np.array([int(p) for _, p in got])
+    np.testing.assert_array_equal(sql_pred, gbt.predict(X))
 
 
 def test_refused_train_preserves_existing_model_table(conn):
