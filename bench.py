@@ -122,18 +122,26 @@ def _measure() -> None:
     lab_d = jnp.asarray(lab)
 
     def timed_epoch_loop(epoch, state):
+        from hivemall_tpu.runtime.benchmark import honest_timed_loop
+
         state, losses = epoch(state, idx_d, val_d, lab_d)  # compile+warm
         jax.block_until_ready(losses)
-        # ~880M rows/s on chip -> 400 rounds gives a ~60ms+ window that
-        # per-dispatch jitter cannot dominate; CPU is ~1000x slower
-        rounds = 400 if platform != "cpu" else 4
-        t0 = time.perf_counter()
-        total_rows = 0
-        for _ in range(rounds):
-            state, losses = epoch(state, idx_d, val_d, lab_d)
-            total_rows += n_blocks * batch
-        jax.block_until_ready(losses)
-        return total_rows / (time.perf_counter() - t0)
+        rows_per_epoch = n_blocks * batch
+
+        def run(s):
+            s2, _ = epoch(s, idx_d, val_d, lab_d)
+            return s2
+
+        # Chunked + budget-bounded + verified: every chunk ends with a
+        # device_get of the carried step counter (checked to have advanced
+        # by exactly chunk * rows_per_epoch), so an async relay that
+        # acknowledges block_until_ready before execution finishes cannot
+        # inflate the rate, and however slow the backend is the loop exits
+        # within its budget (no child-timeout risk).
+        iters, secs, _ = honest_timed_loop(
+            run, state, lambda s: float(s.step), budget_s=6.0,
+            expect_probe_delta=rows_per_epoch)
+        return iters * rows_per_epoch / secs
 
     fn = make_train_fn(AROW, {"r": 0.1}, mode="minibatch")
     arow_rps = timed_epoch_loop(make_epoch(fn),

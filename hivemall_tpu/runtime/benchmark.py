@@ -1,0 +1,77 @@
+"""Honest device-timing helpers for every throughput benchmark.
+
+Motivation (round 4, measured): on a relay-attached TPU,
+`jax.block_until_ready` on an output buffer can return before the producing
+execution has actually finished, so the classic
+"dispatch N times, block once at the end" loop can measure *enqueue* rate
+rather than execution rate — by orders of magnitude (bench_ffm once
+reported 0.015 ms for a step whose scatter traffic alone lower-bounds it
+at ~0.17 ms of HBM time). The only sync a runtime cannot fake is a value
+round-trip: fetching a scalar **computed from the carried state** must
+wait for the real result.
+
+`honest_timed_loop` therefore times auto-ranged chunks of work, ending
+every chunk with a `device_get` of a probe scalar (and verifying a
+monotone step counter when the caller provides one), and includes those
+syncs in the measured wall — so the reported rate can never exceed what
+the device actually sustained.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Tuple
+
+
+def honest_timed_loop(
+    run_once: Callable[[Any], Any],
+    state: Any,
+    probe: Callable[[Any], float],
+    budget_s: float = 6.0,
+    max_chunk: int = 512,
+    grow_below_s: float = 0.25,
+    expect_probe_delta: Optional[float] = None,
+) -> Tuple[int, float, Any]:
+    """Run `state = run_once(state)` repeatedly for ~`budget_s` seconds of
+    *verified* wall time; return (iterations, elapsed_s, state).
+
+    - `probe(state)` must fetch a scalar derived from the carried state
+      (e.g. `lambda s: float(s.step)`); it runs after every chunk and its
+      cost is INCLUDED in elapsed, so async-dispatch artifacts cannot
+      inflate the rate. Chunks auto-double (up to `max_chunk`) while a
+      chunk completes in under `grow_below_s` sec, keeping sync overhead
+      under ~1% for fast backends while a slow backend stays at chunk=1.
+    - With `expect_probe_delta`, the probe value must advance by
+      `expect_probe_delta * chunk` each chunk (e.g. the engine's step
+      counter: blocks_per_epoch * batch); a mismatch raises — catching a
+      runtime that silently skipped executions. The engine's counters are
+      int32, so the loop also returns early before the cumulative count
+      could reach 2^31 and wrap (a fast backend can get there inside the
+      budget).
+    """
+    chunk = 1
+    iters = 0
+    last = probe(state)  # also forces any warmup stragglers to finish
+    counter_cap = (float(2 ** 31 - 1) - last) if expect_probe_delta else None
+    t0 = time.perf_counter()
+    while True:
+        if counter_cap is not None and \
+                (iters + chunk) * expect_probe_delta >= counter_cap:
+            return iters, time.perf_counter() - t0, state
+        c0 = time.perf_counter()
+        for _ in range(chunk):
+            state = run_once(state)
+        val = probe(state)
+        c1 = time.perf_counter()
+        if expect_probe_delta is not None:
+            want = last + expect_probe_delta * chunk
+            if abs(val - want) > 0.5:
+                raise RuntimeError(
+                    f"probe counter mismatch: expected {want}, got {val} "
+                    f"after {chunk} iteration(s) — executions were dropped?")
+        last = val
+        iters += chunk
+        if c1 - t0 >= budget_s:
+            return iters, c1 - t0, state
+        if (c1 - c0) < grow_below_s and chunk < max_chunk:
+            chunk *= 2

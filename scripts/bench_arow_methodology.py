@@ -14,6 +14,11 @@ three timing methodologies so the gap is attributable, not guessed:
 3. single-step  — per-step wall time of an isolated step (what round 1's
    0.023 ms profile measured), extrapolated.
 
+Round-4 honesty note: through the axon relay, `block_until_ready` can
+return before the producing execution finishes (measured — see PERF.md and
+runtime/benchmark.py), so every methodology now ends its timed region with
+a VALUE fetch of a result scalar, which no runtime can satisfy early.
+
 Prints one JSON line per methodology. Rerunnable:
     python scripts/bench_arow_methodology.py [--rounds N]
 """
@@ -81,7 +86,7 @@ def main():
         for b in range(N_BLOCKS):
             state, loss = step(state, idx_d[b], val_d[b], lab_d[b])
             total += BATCH
-    jax.block_until_ready(loss)
+    _ = float(loss)  # value fetch: un-fakeable sync (see runtime/benchmark.py)
     report("python_loop", total, time.perf_counter() - t0)
     del state
 
@@ -104,7 +109,7 @@ def main():
     for _ in range(rounds):
         state, losses = epoch(state, idx_d, val_d, lab_d)
         total += N_BLOCKS * BATCH
-    jax.block_until_ready(losses)
+    _ = float(losses[-1])  # value fetch: un-fakeable sync
     report("device_scan", total, time.perf_counter() - t0)
     del state
 
@@ -118,7 +123,7 @@ def main():
     for i in range(n):
         state, loss = step2(state, idx_d[i % N_BLOCKS], val_d[i % N_BLOCKS],
                             lab_d[i % N_BLOCKS])
-        jax.block_until_ready(loss)
+        _ = float(loss)  # value fetch: un-fakeable per-step sync
     report("single_step_sync", n * BATCH, time.perf_counter() - t0)
 
 

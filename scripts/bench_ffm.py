@@ -10,7 +10,6 @@ Run (CPU):       PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/bench_ff
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -43,29 +42,28 @@ def main() -> None:
     lab_d = jnp.asarray(lab)
 
     from hivemall_tpu.core.engine import make_epoch
+    from hivemall_tpu.runtime.benchmark import honest_timed_loop
 
-    rounds = 10 if platform != "cpu" else 2
     for name, rc in (("untiled", None), ("row_chunk512", 512)):
         fn = make_ffm_step(hyper, "minibatch", row_chunk=rc, jit=False)
-        # one epoch = one dispatch (device-resident scan over staged blocks)
+        # one epoch = one dispatch (device-resident scan over staged blocks);
+        # timing is chunked + step-counter-verified (runtime/benchmark.py) so
+        # an async relay cannot inflate the rate
         epoch = make_epoch(fn)
 
         state = init_ffm_state(hyper)
         state, losses = epoch(state, idx_d, val_d, fld_d, lab_d)
         jax.block_until_ready(losses)
-        t0 = time.perf_counter()
-        total_rows = 0
-        for _ in range(rounds):
-            state, losses = epoch(state, idx_d, val_d, fld_d, lab_d)
-            total_rows += n_blocks * batch
-        jax.block_until_ready(losses)
-        dt = time.perf_counter() - t0
+        iters, dt, _ = honest_timed_loop(
+            lambda s: epoch(s, idx_d, val_d, fld_d, lab_d)[0], state,
+            lambda s: float(s.step), budget_s=6.0,
+            expect_probe_delta=n_blocks * batch)
         print(json.dumps({
             "metric": f"ffm_train_throughput_k4_{width}nnz_{fields}fields_"
                       f"{name}_device_scan_{platform}",
-            "value": round(total_rows / dt, 1),
+            "value": round(iters * n_blocks * batch / dt, 1),
             "unit": "rows/sec",
-            "ms_per_step": round(1e3 * dt / (rounds * n_blocks), 3),
+            "ms_per_step": round(1e3 * dt / (iters * n_blocks), 3),
         }), flush=True)
         del state
 

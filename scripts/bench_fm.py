@@ -8,7 +8,6 @@ Run (CPU):       PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/bench_fm
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -45,26 +44,26 @@ def main() -> None:
     fn = make_fm_step(hyper, mode="minibatch", jit=False)
     epoch = make_epoch(lambda s, bi, bv, bl: fn(s, bi, bv, bl, va_d))
 
+    from hivemall_tpu.runtime.benchmark import honest_timed_loop
+
     # one epoch = one dispatch (the deployment shape — io/records.py prefetch
-    # + on-device epoch replay, mirroring FactorizationMachineUDTF.java:521)
+    # + on-device epoch replay, mirroring FactorizationMachineUDTF.java:521);
+    # timing is chunked + step-counter-verified (runtime/benchmark.py) so an
+    # async relay cannot inflate the rate
     state = init_fm_state(dims, hyper)
     state, losses = epoch(state, idx_d, val_d, lab_d)
     jax.block_until_ready(losses)
 
-    t0 = time.perf_counter()
-    rounds = 40 if platform != "cpu" else 4
-    total_rows = 0
-    for _ in range(rounds):
-        state, losses = epoch(state, idx_d, val_d, lab_d)
-        total_rows += n_blocks * batch
-    jax.block_until_ready(losses)
-    dt = time.perf_counter() - t0
-    rows_per_sec = total_rows / dt
+    iters, dt, _ = honest_timed_loop(
+        lambda s: epoch(s, idx_d, val_d, lab_d)[0], state,
+        lambda s: float(s.step), budget_s=6.0,
+        expect_probe_delta=n_blocks * batch)
+    rows_per_sec = iters * n_blocks * batch / dt
     print(json.dumps({
         "metric": f"fm_train_throughput_2^22dims_k5_{width}nnz_device_scan_{platform}",
         "value": round(rows_per_sec, 1),
         "unit": "rows/sec",
-        "ms_per_step": round(1e3 * dt / (rounds * n_blocks), 3),
+        "ms_per_step": round(1e3 * dt / (iters * n_blocks), 3),
     }))
 
 

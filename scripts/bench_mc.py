@@ -12,7 +12,6 @@ Run (CPU):       PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/bench_mc
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -50,24 +49,24 @@ def main() -> None:
             step=jnp.zeros((), jnp.int32),
         )
 
+    from hivemall_tpu.runtime.benchmark import honest_timed_loop
+
     state = fresh()
     state, losses = epoch(state, idx_d, val_d, lab_d)
     jax.block_until_ready(losses)
 
-    rounds = 40 if platform != "cpu" else 2
-    t0 = time.perf_counter()
-    total_rows = 0
-    for _ in range(rounds):
-        state, losses = epoch(state, idx_d, val_d, lab_d)
-        total_rows += n_blocks * batch
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
+    # chunked + step-counter-verified timing (runtime/benchmark.py) so an
+    # async relay cannot inflate the rate
+    iters, dt, _ = honest_timed_loop(
+        lambda s: epoch(s, idx_d, val_d, lab_d)[0], state,
+        lambda s: float(s.step), budget_s=6.0,
+        expect_probe_delta=n_blocks * batch)
     print(json.dumps({
         "metric": f"mc_arow_train_throughput_{L}labels_2^20dims_{width}nnz_"
                   f"device_scan_{platform}",
-        "value": round(total_rows / dt, 1),
+        "value": round(iters * n_blocks * batch / dt, 1),
         "unit": "rows/sec",
-        "ms_per_step": round(1e3 * dt / (rounds * n_blocks), 3),
+        "ms_per_step": round(1e3 * dt / (iters * n_blocks), 3),
     }), flush=True)
 
 
