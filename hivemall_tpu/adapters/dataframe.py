@@ -108,8 +108,9 @@ class _GroupedOps:
 class HivemallFrame:
     """Thin wrapper exposing registry functions as DataFrame methods."""
 
-    def __init__(self, df):
+    def __init__(self, df, mix_servs: Optional[str] = None):
         self._df = df
+        self._mix_servs = mix_servs
 
     @property
     def df(self):
@@ -118,6 +119,10 @@ class HivemallFrame:
     def groupby(self, by: str) -> _GroupedOps:
         return _GroupedOps(self._df, by)
 
+    def _wrap(self, df) -> "HivemallFrame":
+        """Transforms keep the set_mix_servs config of the source frame."""
+        return HivemallFrame(df, mix_servs=self._mix_servs)
+
     # ---- trainers: df.train_xxx(features_col, label_col, options) ----
     def __getattr__(self, name: str):
         if name.startswith("train_"):
@@ -125,19 +130,40 @@ class HivemallFrame:
 
             def trainer(features_col: str, label_col: str,
                         options: Optional[str] = None, **kw):
+                from ..utils.options import OptionError
+
                 feats = self._df[features_col].tolist()
                 labels = self._df[label_col].to_numpy()
+                if self._mix_servs:
+                    mix = f"-mix {self._mix_servs}"
+                    try:
+                        return fn(feats, labels,
+                                  f"{options} {mix}" if options else mix, **kw)
+                    except OptionError as e:
+                        if "unknown option '-mix'" not in str(e):
+                            raise
+                        # batch trainers (forest/GBT) take no -mix, like the
+                        # reference's own UDTFs; train without it
+                        import warnings
+
+                        warnings.warn(f"{name} does not accept -mix; "
+                                      "set_mix_servs ignored for this trainer")
                 return fn(feats, labels, options, **kw)
 
             return trainer
         raise AttributeError(name)
+
+    def set_mix_servs(self, servers: str) -> "HivemallFrame":
+        """Inject `-mix <servers>` into every subsequent train_* call
+        (ref: HivemallOps.scala:692 setMixServs)."""
+        return HivemallFrame(self._df, mix_servs=servers)
 
     # ---- row transforms mirroring HivemallOps:521-673 ----
     def amplify(self, xtimes: int) -> "HivemallFrame":
         import pandas as pd
 
         idx = np.repeat(np.arange(len(self._df)), xtimes)
-        return HivemallFrame(self._df.iloc[idx].reset_index(drop=True))
+        return self._wrap(self._df.iloc[idx].reset_index(drop=True))
 
     def rand_amplify(self, xtimes: int, num_buffers: int = 2,
                      seed: int = 31) -> "HivemallFrame":
@@ -147,7 +173,64 @@ class HivemallFrame:
 
         rows = list(ra(xtimes, num_buffers, self._df.itertuples(index=False),
                        seed=seed))
-        return HivemallFrame(pd.DataFrame(rows, columns=list(self._df.columns)))
+        return self._wrap(pd.DataFrame(rows, columns=list(self._df.columns)))
+
+    def part_amplify(self, xtimes: int) -> "HivemallFrame":
+        """Partition-local amplify (HivemallOps.scala part_amplify). A pandas
+        DataFrame is one partition, so this equals `amplify` without any
+        shuffle — kept as its own method so ported Spark code reads 1:1."""
+        return self.amplify(xtimes)
+
+    def explode_array(self, col: str) -> "HivemallFrame":
+        """One output row per array element (HivemallOps.scala explode_array).
+        Empty/None/NaN cells yield zero rows (Hive explode semantics)
+        rather than pandas' NaN placeholder row."""
+        keep = self._df[col].map(
+            lambda a: isinstance(a, (list, tuple, np.ndarray)) and len(a) > 0)
+        return self._wrap(self._df[keep].explode(col).reset_index(drop=True))
+
+    def minhash(self, item_col: str, features_col: str, num_hashes: int = 5,
+                num_keygroups: int = 2) -> "HivemallFrame":
+        """Emit (clusterid, item) pairs per row — one per hash function
+        (HivemallOps.scala minhash over knn/lsh/MinHashUDTF.java)."""
+        from ..knn import minhash as mh
+
+        import pandas as pd
+
+        rows = []
+        for r in self._df.to_dict("records"):
+            rows.extend(mh(r[item_col], r[features_col],
+                           num_hashes, num_keygroups))
+        return self._wrap(pd.DataFrame(rows, columns=["clusterid", item_col]))
+
+    def quantify(self, *cols: str) -> "HivemallFrame":
+        """Map non-numeric values of the given columns (all columns when none
+        given) to dense int ids in first-seen order, sharing one quantifier
+        across rows (HivemallOps.scala quantify over QuantifyColumnsUDTF)."""
+        from ..ftvec import Quantifier
+
+        out = self._df.copy()
+        use = list(cols) if cols else list(out.columns)
+        q = Quantifier()
+        for ci, c in enumerate(use):
+            out[c] = [q.quantify(ci, v) for v in out[c]]
+        return self._wrap(out)
+
+    def binarize_label(self, pos_col: str, neg_col: str,
+                       *feature_cols: str) -> "HivemallFrame":
+        """Expand aggregated (pos_count, neg_count, features...) rows into
+        `pos` label-1 rows and `neg` label-0 rows
+        (HivemallOps.scala binarize_label over BinarizeLabelUDTF)."""
+        from ..ftvec import binarize_label as bl
+
+        import pandas as pd
+
+        rows = []
+        for r in self._df.to_dict("records"):
+            feats = tuple(r[c] for c in feature_cols)
+            rows.extend(bl(int(r[pos_col]), int(r[neg_col]), *feats))
+        return self._wrap(
+            pd.DataFrame(rows, columns=list(feature_cols) + ["label"]))
 
     def each_top_k(self, k: int, group_col: str, value_col: str) -> "HivemallFrame":
         from ..tools import each_top_k as etk
@@ -161,11 +244,22 @@ class HivemallFrame:
                                      else payload)
                for rank, value, payload in etk(k, rows_in)]
         cols = ["rank", "value"] + list(df.columns)
-        return HivemallFrame(pd.DataFrame(out, columns=cols))
+        return self._wrap(pd.DataFrame(out, columns=cols))
 
 
 def hivemall_ops(df) -> HivemallFrame:
     return HivemallFrame(df)
+
+
+def lr_datagen_frame(options: Optional[str] = None):
+    """Synthetic LR dataset as a DataFrame with features/label columns
+    (HivemallOps.scala lr_datagen over dataset/LogisticRegressionDataGeneratorUDTF)."""
+    from ..dataset import lr_datagen
+
+    import pandas as pd
+
+    rows, labels = lr_datagen(options)
+    return pd.DataFrame({"features": list(rows), "label": labels})
 
 
 def predict_stream(model, batches: Iterable, features_col: str = "features"

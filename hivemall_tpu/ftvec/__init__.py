@@ -18,6 +18,7 @@ from .hashing import feature_hashing  # noqa: F401
 from .pairing import polynomial_features, powered_features  # noqa: F401
 from .scaling import l2_normalize, rescale, zscore  # noqa: F401
 from .trans import (  # noqa: F401
+    Quantifier,
     binarize_label,
     categorical_features,
     ffm_features,

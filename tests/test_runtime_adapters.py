@@ -161,6 +161,60 @@ class TestAdapters:
         outs = list(predict_stream(model, batches))
         assert len(outs) == 2 and len(outs[0]) == 50
 
+    def test_part_amplify_and_explode_array(self):
+        import pandas as pd
+
+        from hivemall_tpu.adapters import hivemall_ops
+
+        hf = hivemall_ops(self._df())
+        assert len(hf.part_amplify(2).df) == 400
+        df = pd.DataFrame({"id": [1, 2], "arr": [[10, 20], [30]]})
+        out = hivemall_ops(df).explode_array("arr").df
+        assert out["arr"].tolist() == [10, 20, 30]
+
+    def test_minhash_dsl(self):
+        import pandas as pd
+
+        from hivemall_tpu.adapters import hivemall_ops
+        from hivemall_tpu.knn import minhashes
+
+        df = pd.DataFrame({"item": [7], "features": [["a:1", "b:1"]]})
+        out = hivemall_ops(df).minhash("item", "features").df
+        assert out["item"].tolist() == [7] * 5  # one row per hash function
+        assert out["clusterid"].tolist() == minhashes(["a:1", "b:1"])
+
+    def test_quantify_dsl(self):
+        import pandas as pd
+
+        from hivemall_tpu.adapters import hivemall_ops
+
+        df = pd.DataFrame({"color": ["red", "blue", "red"], "n": [3, 1, 2]})
+        out = hivemall_ops(df).quantify("color", "n").df
+        assert out["color"].tolist() == [0.0, 1.0, 0.0]  # first-seen ids
+        assert out["n"].tolist() == [3.0, 1.0, 2.0]  # numerics pass through
+
+    def test_binarize_label_dsl(self):
+        import pandas as pd
+
+        from hivemall_tpu.adapters import hivemall_ops
+
+        df = pd.DataFrame({"pos": [2, 0], "neg": [1, 1],
+                           "features": [["a:1"], ["b:1"]]})
+        out = hivemall_ops(df).binarize_label("pos", "neg", "features").df
+        assert out["label"].tolist() == [1, 1, 0, 0]
+        assert out["features"].iloc[3] == ["b:1"]
+
+    def test_lr_datagen_frame_and_set_mix_servs(self):
+        from hivemall_tpu.adapters import hivemall_ops
+        from hivemall_tpu.adapters.dataframe import lr_datagen_frame
+
+        df = lr_datagen_frame("-n_examples 120 -n_features 5 -n_dims 32 -cl")
+        assert len(df) == 120 and set(df["label"]) <= {0.0, 1.0}
+        # -mix injection must parse cleanly through every trainer's options
+        hf = hivemall_ops(df).set_mix_servs("host1,host2")
+        model = hf.train_perceptron("features", "label", "-dims 32")
+        assert model.predict(df["features"].tolist()).shape == (120,)
+
 
 class TestTokenizeJaExtended:
     def test_extended_unigrams_unknown_words(self):
