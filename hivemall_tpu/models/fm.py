@@ -70,22 +70,44 @@ class FMHyper:
     va_ratio: float = 0.05
     seed: int = 31
 
+    @property
+    def padded_factors(self) -> int:
+        """Physical lane count of the V table: k rounded up to a multiple
+        of 8 when k > 4 (TPU f32 sublane granularity is 8; a [N, 5]-row
+        gather/scatter measured ~9x the per-row cost of an aligned one —
+        diag_scan_perf micro2 on v5e). Pad lanes init to 0 and provably
+        stay 0 (their grad terms are products with their own zero V
+        entries), so every k-width result is bit-identical; model_rows /
+        codecs slice back to the logical k."""
+        k = self.factors
+        if k > 4 and k % 8:
+            return k + (8 - k % 8)
+        return k
+
 
 def init_fm_state(dims: int, hyper: FMHyper) -> FMState:
     k = hyper.factors
+    k_pad = hyper.padded_factors
     key = jax.random.PRNGKey(hyper.seed)
     # 'random' init: uniform in [-maxval..maxval]-ish; 'gaussian': N(0, sigma).
     # We use gaussian * sigma for both (the reference default for
     # classification; regression's 'random' differs only in distribution shape,
     # ref: fm/VInitScheme.java).
     v = jax.random.normal(key, (dims, k), dtype=jnp.float32) * hyper.sigma
+    if k_pad != k:
+        v = jnp.concatenate(
+            [v, jnp.zeros((dims, k_pad - k), jnp.float32)], axis=1)
     return FMState(
         w0=jnp.zeros((), jnp.float32),
         w=jnp.zeros((dims,), jnp.float32),
         v=v,
         lambda_w0=jnp.asarray(hyper.lambda0, jnp.float32),
         lambda_w=jnp.asarray(hyper.lambda0, jnp.float32),
-        lambda_v=jnp.full((k,), hyper.lambda0, jnp.float32),
+        # pad-lane lambdas are 0: their V entries are pinned at 0, so any
+        # nonzero lambda would only add a dead multiply
+        lambda_v=jnp.concatenate(
+            [jnp.full((k,), hyper.lambda0, jnp.float32),
+             jnp.zeros((k_pad - k,), jnp.float32)]),
         touched=jnp.zeros((dims,), jnp.int8),
         step=jnp.zeros((), jnp.int32),
     )
@@ -321,7 +343,8 @@ class TrainedFMModel:
         touched = np.asarray(self.state.touched) != 0
         feats = np.nonzero(touched)[0].astype(np.int64)
         w = np.asarray(self.state.w)[feats]
-        v = np.asarray(self.state.v)[feats]
+        # slice physical lane padding (padded_factors) back to the logical k
+        v = np.asarray(self.state.v)[feats][:, :self.hyper.factors]
         return float(self.state.w0), feats, w, v
 
 
@@ -435,7 +458,7 @@ def _train_fm_native_scan(cl, hyper: FMHyper, dims, idx_rows, val_rows,
     st = {
         "w0": np.zeros(1, np.float32),
         "w": np.concatenate([np.asarray(state0.w), np.zeros(1, np.float32)]),
-        "V": np.concatenate([np.asarray(state0.v),
+        "V": np.concatenate([np.asarray(state0.v)[:, :k],
                              np.zeros((1, k), np.float32)]),
         "touch": np.zeros(dims + 1, np.uint8),
     }
@@ -467,10 +490,15 @@ def _train_fm_native_scan(cl, hyper: FMHyper, dims, idx_rows, val_rows,
         conv.incr_loss(float(epoch_errors))
         if iters > 1 and conv.is_converged(n):
             break
+    v_back = st["V"][:dims]
+    if hyper.padded_factors != k:  # restore the physical lane padding
+        v_back = np.concatenate(
+            [v_back, np.zeros((dims, hyper.padded_factors - k), np.float32)],
+            axis=1)
     state = state0.replace(
         w0=jnp.asarray(np.float32(st["w0"][0])),
         w=jnp.asarray(st["w"][:dims]),
-        v=jnp.asarray(st["V"][:dims]),
+        v=jnp.asarray(v_back),
         touched=jnp.asarray((st["touch"][:dims] != 0).astype(np.int8)),
         step=jnp.asarray(np.int32(n * (it + 1))),
     )

@@ -52,11 +52,16 @@ def honest_timed_loop(
     chunk = 1
     iters = 0
     last = probe(state)  # also forces any warmup stragglers to finish
-    counter_cap = (float(2 ** 31 - 1) - last) if expect_probe_delta else None
+    counter_cap = (float(2 ** 31 - 1) - last) \
+        if (expect_probe_delta is not None and expect_probe_delta > 0) else None
     t0 = time.perf_counter()
     while True:
         if counter_cap is not None and \
                 (iters + chunk) * expect_probe_delta >= counter_cap:
+            if iters == 0:
+                raise RuntimeError(
+                    f"probe counter {last} already within one chunk of int32 "
+                    "wrap — reset the state before timing")
             return iters, time.perf_counter() - t0, state
         c0 = time.perf_counter()
         for _ in range(chunk):
@@ -75,3 +80,43 @@ def honest_timed_loop(
             return iters, c1 - t0, state
         if (c1 - c0) < grow_below_s and chunk < max_chunk:
             chunk *= 2
+
+
+def measure_reference_rowloops(idx, val, lab, dims: int, k: int = 5,
+                               budget_s: float = 2.0) -> dict:
+    """Time the C transliterations of the reference's per-row hot loops
+    (native hm_arow_reference_rowloop / hm_fm_reference_rowloop) on the
+    given host arrays — the measured vs_baseline anchor denominators shared
+    by bench.py and scripts/bench_ctr_e2e.py. Parse/boxing costs are
+    excluded (flatters the reference). Returns {} when the native library
+    is missing or predates the anchor symbols (a probe call returning None
+    — never time no-op calls)."""
+    from .. import native
+
+    out: dict = {}
+    if not native.available():
+        return out
+    n = len(lab)
+    for name, call in (
+        ("arow", lambda s: native.arow_reference_rowloop(
+            idx, val, lab, dims, state=s)),
+        ("fm", lambda s: native.fm_reference_rowloop(
+            idx, val, lab, dims, k=k, state=s)),
+    ):
+        st: dict = {}
+        probe_call = (native.arow_reference_rowloop if name == "arow"
+                      else lambda *a, **kw: native.fm_reference_rowloop(
+                          *a, k=k, **kw))
+        # probe on st itself: detects missing symbols AND warms the model
+        # table allocation so it never lands inside the timed window
+        if probe_call(idx[:2048], val[:2048], lab[:2048], dims,
+                      state=st) is None:
+            continue
+        t0 = time.perf_counter()
+        done = 0
+        while time.perf_counter() - t0 < budget_s:
+            call(st)
+            done += n
+        out[f"{name}_rows_per_sec"] = round(
+            done / (time.perf_counter() - t0), 1)
+    return out

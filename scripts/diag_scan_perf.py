@@ -138,6 +138,74 @@ def main():
                   .at[i].get(mode="fill", fill_value=1.0), 1.0),
               mode="drop"), donate_argnums=(0,)), dup_idx, upd)
 
+    # ---- micro2: round-4b variants suggested by the first TPU capture
+    # (v5 row-scatter 9x the scalar cost; gather 2x the scatter; k=4 FM
+    # epoch 1.4x faster than k=5 => lane-alignment hypothesis) ----
+    # uniform placement (hash-realistic): same duplicate frequency as zipf,
+    # ids spread over [0, D) by a fixed permutation
+    perm = rng.permutation(DIMS).astype(np.int32)
+    uni_idx = jnp.asarray(perm[np.asarray(dup_idx)])
+    micro("micro2_scatter_add_dup_uniform_placed", t1, scat, uni_idx, upd)
+    micro("micro2_gather_dup_uniform_placed", t1, gath, uni_idx)
+
+    # packed pair table [D,2] (w+cov interleaved): one row gather vs two
+    # scalar gathers; row scatter vs two scalar scatters
+    upd2 = jnp.asarray(rng.randn(N_UPD, 2).astype(np.float32))
+
+    def t2():
+        return jnp.zeros((DIMS, 2), jnp.float32)
+
+    micro("micro2_gather_pair_dup", t2,
+          jax.jit(lambda v, i: v.at[0, 0].add(jnp.sum(v.at[i].get(
+              mode="fill", fill_value=0.0))), donate_argnums=(0,)), dup_idx)
+    micro("micro2_scatter_pair_rows_dup", t2, scat, dup_idx, upd2)
+
+    # FM V-update alternatives: flat [D*k] scalar scatter with computed
+    # lane ids; k unrolled scalar scatters into [k, D] planes; and the
+    # engine's chosen fix — [D, 8] lane-padded rows (k=5 in 8 lanes)
+    flat_idx5 = (dup_idx[:, None] * 5 +
+                 jnp.arange(5, dtype=jnp.int32)[None, :]).reshape(-1)
+
+    def t5flat():
+        return jnp.zeros((DIMS * 5,), jnp.float32)
+
+    micro("micro2_scatter_v5_flat_dup", t5flat, scat, flat_idx5,
+          upd5.reshape(-1))
+
+    def scat_perk(v, i, u):
+        for f in range(5):
+            v = v.at[f, i].add(u[:, f], mode="drop")
+        return v
+
+    micro("micro2_scatter_v5_perk_dup",
+          lambda: jnp.zeros((5, DIMS), jnp.float32),
+          jax.jit(scat_perk, donate_argnums=(0,)), dup_idx, upd5)
+
+    upd8 = jnp.concatenate(
+        [upd5, jnp.zeros((N_UPD, 3), jnp.float32)], axis=1)
+    micro("micro2_scatter_v8pad_dup",
+          lambda: jnp.zeros((DIMS, 8), jnp.float32), scat, dup_idx, upd8)
+
+    # gather side of the same layouts
+    micro("micro2_gather_v5_rows_dup",
+          lambda: jnp.zeros((DIMS, 5), jnp.float32),
+          jax.jit(lambda v, i: v.at[0, 0].add(jnp.sum(v.at[i].get(
+              mode="fill", fill_value=0.0))), donate_argnums=(0,)), dup_idx)
+    micro("micro2_gather_v8pad_dup",
+          lambda: jnp.zeros((DIMS, 8), jnp.float32),
+          jax.jit(lambda v, i: v.at[0, 0].add(jnp.sum(v.at[i].get(
+              mode="fill", fill_value=0.0))), donate_argnums=(0,)), dup_idx)
+
+    def gath_perk(v, i):
+        s = 0.0
+        for f in range(5):
+            s = s + jnp.sum(v.at[f, i].get(mode="fill", fill_value=0.0))
+        return v.at[0, 0].add(s)
+
+    micro("micro2_gather_v5_perk_dup",
+          lambda: jnp.zeros((5, DIMS), jnp.float32),
+          jax.jit(gath_perk, donate_argnums=(0,)), dup_idx)
+
     # the dedup path (ops/scatter.py): sort + segment-sum + unique scatter
     from hivemall_tpu.ops.scatter import (dedup_counts, dedup_scatter_add,
                                           make_dedup_plan)
@@ -159,7 +227,12 @@ def main():
 
     # ---------------- B/C. engine epochs ---------------------------------
     def blocks(n):
-        idx = (rng.zipf(1.3, size=(n, BATCH, WIDTH)) % DIMS).astype(np.int32)
+        # the headline workload shape (bench.make_ids): log-uniform
+        # frequency, hash-uniform placement — so section B/C epoch numbers
+        # transfer to what bench.py actually times
+        from bench import make_ids
+
+        idx = make_ids(rng, (n, BATCH, WIDTH))
         val = np.ones((n, BATCH, WIDTH), dtype=np.float32)
         lab = np.sign(rng.randn(n, BATCH)).astype(np.float32)
         return jnp.asarray(idx), jnp.asarray(val), jnp.asarray(lab)

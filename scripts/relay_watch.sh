@@ -26,8 +26,35 @@ DEADLINE=$(( $(date +%s) + 11*3600 ))
 publish() {  # publish <tag> <lines-file>: keep each tag's LATEST capture and
   # regenerate $OUT from all tags — a clean rerun replaces its own earlier
   # partial lines, while distinct tags with identical metric names (the two
-  # bench.py variance runs) both keep their samples
+  # bench.py variance runs) both keep their samples. _legacy (pre-watcher
+  # snapshot) rows are dropped once ANY per-tag capture carries the same
+  # metric name, so a recapture under new code replaces the stale record
+  # instead of duplicating it.
   cp "$2" "$DONE_DIR/$1.jsonl"
+  if [ -f "$DONE_DIR/_legacy.jsonl" ]; then
+    python3 - "$DONE_DIR" <<'PYEOF'
+import glob, json, os, sys
+d = sys.argv[1]
+fresh = set()
+for f in glob.glob(os.path.join(d, "*.jsonl")):
+    if os.path.basename(f) == "_legacy.jsonl":
+        continue
+    for line in open(f):
+        try:
+            fresh.add(json.loads(line)["metric"])
+        except Exception:
+            pass
+keep = []
+for line in open(os.path.join(d, "_legacy.jsonl")):
+    try:
+        if json.loads(line)["metric"] in fresh:
+            continue
+    except Exception:
+        pass
+    keep.append(line)
+open(os.path.join(d, "_legacy.jsonl"), "w").writelines(keep)
+PYEOF
+  fi
   cat "$DONE_DIR"/*.jsonl > "$OUT" 2>/dev/null
 }
 
@@ -73,33 +100,35 @@ run_one() {  # run_one <tag> <cmd...>
 }
 
 all_done() {
-  for t in diag_micro diag_arow diag_fm ctr_e2e fm ffm mc methodology \
-           forest arow1 arow2; do
+  for t in diag_micro diag_arow diag_fm diag_micro2 ctr_e2e fm ffm mc \
+           methodology forest arow1 arow2; do
     [ -e "$DONE_DIR/$t" ] || return 1
   done
 }
 
-# Order: the scan-perf diagnostic first (its scatter cost model decides the
-# engine optimization) — split into three --only groups so each fits well
-# inside one run_one timeout and completed groups never re-run; then the
-# headline benches (all retimed round 4 with un-fakeable
-# step-counter-verified syncs — runtime/benchmark.py), the e2e, and the
-# dispatch-heavy forest bench last (it once ate a whole window).
+# Order (cheapest-first within priority): the headline bench.py line
+# first (the one BENCH_r04 must carry), then the scan-perf diagnostics
+# (the cost model for the engine optimizations; --only groups so completed
+# groups never re-run), then the per-family benches, and the two LONG runs
+# last — forest (dispatch-heavy; once ate a whole window) and the ctr
+# e2e — so a short window still captures everything cheap, with a second
+# bench.py variance sample at the very end.
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     echo "[$(date +%T)] relay up" >&2
-    run_one diag_micro python -u scripts/diag_scan_perf.py --budget 3 --only micro
+    run_one arow1   python -u bench.py
+    run_one diag_micro python -u scripts/diag_scan_perf.py --budget 3 --only micro_
     run_one diag_arow  python -u scripts/diag_scan_perf.py --budget 3 --only arow
     run_one diag_fm    python -u scripts/diag_scan_perf.py --budget 3 --only fm
-    run_one arow1   python -u bench.py
+    run_one diag_micro2 python -u scripts/diag_scan_perf.py --budget 3 --only micro2_
     run_one fm      python -u scripts/bench_fm.py
     run_one ffm     python -u scripts/bench_ffm.py
     run_one mc      python -u scripts/bench_mc.py
     run_one methodology python -u scripts/bench_arow_methodology.py
+    run_one forest  python -u scripts/bench_forest.py
     run_one ctr_e2e python -u scripts/bench_ctr_e2e.py \
       --train-rows 2097152 --test-rows 262144 --epochs-arow 4 --epochs-fm 4
     run_one arow2   python -u bench.py
-    run_one forest  python -u scripts/bench_forest.py
     if all_done; then
       echo "[$(date +%T)] suite complete" >&2
       exit 0
