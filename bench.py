@@ -36,32 +36,14 @@ DIMS = 1 << 22
 FM_FACTORS = 5
 
 
-def make_ids(rng, shape):
-    """Feature ids: log-uniform (heavy-tailed) FREQUENCY with hash-UNIFORM
-    placement — the north-star workload shape (same id distribution as
-    scripts/bench_ctr_e2e.py's KDD-shaped generator).
+def make_ids(rng, shape, dims=DIMS):
+    """Shared workload generator (see
+    hivemall_tpu.runtime.benchmark.make_workload_ids for the rationale);
+    kept here as the bench-policy entry point with the headline DIMS
+    default."""
+    from hivemall_tpu.runtime.benchmark import make_workload_ids
 
-    Two deliberate properties, both measured to matter (round 4):
-    - Frequency: zipf(1.3) (rounds 1-3) is TOO head-heavy — 2M draws touch
-      so few distinct features that the C anchor's whole working set stays
-      cache-resident (measured 5.8-6.2M rows/s regardless of placement).
-      Log-uniform over [1, D) matches the e2e generator: a realistic
-      distinct-feature count per epoch, like hashed CTR traffic.
-    - Placement: raw samples concentrate hot ids in the table's first
-      cache lines — a contiguity gift real murmur-hashed features never
-      give. A fixed permutation spreads them uniformly, preserving the
-      duplicate multiset (same TPU scatter collisions; TPU measured
-      placement-insensitive — scatter 70.8 -> 76.8M upd/s zipf -> uniform,
-      diag micro2)."""
-    global _PERM
-    if _PERM is None:
-        _PERM = np.random.RandomState(12345).permutation(DIMS).astype(np.int32)
-    u = rng.random_sample(shape)
-    ids = np.exp(u * np.log(float(DIMS))).astype(np.int64) % DIMS
-    return _PERM[ids]
-
-
-_PERM = None
+    return make_workload_ids(rng, shape, dims)
 
 
 def _measure_anchors() -> dict:

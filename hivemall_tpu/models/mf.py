@@ -93,7 +93,8 @@ def init_mf_state(num_users: int, num_items: int, hyper: MFHyper) -> MFState:
     )
 
 
-def make_mf_step(hyper: MFHyper, mode: str = "minibatch"):
+def make_mf_step(hyper: MFHyper, mode: str = "minibatch",
+                 jit: bool = True):
     """Rating-MF block update over (users [B], items [B], ratings [B])."""
 
     def row_deltas(st: MFState, u, i, r, t):
@@ -157,10 +158,14 @@ def make_mf_step(hyper: MFHyper, mode: str = "minibatch"):
         return apply(state, users, items, dP, dQ, dbu, dbi, dmu, dggp, dggq, b), \
             jnp.sum(loss)
 
-    return jax.jit(scan_step if mode == "scan" else minibatch_step, donate_argnums=(0,))
+    step = scan_step if mode == "scan" else minibatch_step
+    # jit=False returns the raw traceable fn for embedding in an outer scan
+    # (whole-epoch lax.scan over staged blocks, scripts/bench_mf.py)
+    return jax.jit(step, donate_argnums=(0,)) if jit else step
 
 
-def make_bpr_step(hyper: "BPRHyper", mode: str = "minibatch"):
+def make_bpr_step(hyper: "BPRHyper", mode: str = "minibatch",
+                  jit: bool = True):
     def dloss_fn(x):
         if hyper.loss == "sigmoid":
             return 1.0 / (1.0 + jnp.exp(x))
@@ -218,7 +223,10 @@ def make_bpr_step(hyper: "BPRHyper", mode: str = "minibatch"):
             lambda u, i, j, t: row_deltas(state, u, i, j, t))(users, pos, neg, ts)
         return apply(state, users, pos, neg, dP, dQi, dQj, dbi, dbj, b), jnp.sum(loss)
 
-    return jax.jit(scan_step if mode == "scan" else minibatch_step, donate_argnums=(0,))
+    step = scan_step if mode == "scan" else minibatch_step
+    # jit=False returns the raw traceable fn for embedding in an outer scan
+    # (whole-epoch lax.scan over staged blocks, scripts/bench_mf.py)
+    return jax.jit(step, donate_argnums=(0,)) if jit else step
 
 
 @dataclass(frozen=True)

@@ -22,6 +22,35 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, Optional, Tuple
 
+_PERMS: dict = {}
+
+
+def make_workload_ids(rng, shape, dims: int):
+    """Benchmark feature ids: log-uniform (heavy-tailed) FREQUENCY with
+    hash-UNIFORM placement — the north-star workload shape shared by
+    bench.py, every scripts/bench_*.py, and diag_scan_perf.py (same id
+    distribution as the e2e generator's hashed CTR traffic).
+
+    Two deliberate properties, both measured to matter (round 4):
+    - Frequency: zipf(1.3) (rounds 1-3) is TOO head-heavy — 2M draws touch
+      so few distinct features that the C anchor's whole working set stays
+      cache-resident. Log-uniform over [1, dims) gives a realistic
+      distinct-feature count per epoch.
+    - Placement: raw samples concentrate hot ids in the table's first
+      cache lines — a contiguity gift real murmur-hashed features never
+      give. A fixed permutation spreads them uniformly, preserving the
+      duplicate multiset (same TPU scatter collisions; TPU measured
+      placement-insensitive — diag micro uniform-placed rows in
+      PERF_TPU_r04.jsonl)."""
+    import numpy as np
+
+    if dims not in _PERMS:
+        _PERMS[dims] = np.random.RandomState(12345).permutation(
+            dims).astype(np.int32)
+    u = rng.random_sample(shape)
+    ids = np.exp(u * np.log(float(dims))).astype(np.int64) % dims
+    return _PERMS[dims][ids]
+
 
 def honest_timed_loop(
     run_once: Callable[[Any], Any],
@@ -97,25 +126,24 @@ def measure_reference_rowloops(idx, val, lab, dims: int, k: int = 5,
     if not native.available():
         return out
     n = len(lab)
-    for name, call in (
-        ("arow", lambda s: native.arow_reference_rowloop(
-            idx, val, lab, dims, state=s)),
-        ("fm", lambda s: native.fm_reference_rowloop(
-            idx, val, lab, dims, k=k, state=s)),
+    # ONE closure per family, used for both the probe and the timed loop,
+    # so the probe can never validate a different code path than the one
+    # being timed
+    for name, rowloop in (
+        ("arow", lambda i, v, l, s: native.arow_reference_rowloop(
+            i, v, l, dims, state=s)),
+        ("fm", lambda i, v, l, s: native.fm_reference_rowloop(
+            i, v, l, dims, k=k, state=s)),
     ):
         st: dict = {}
-        probe_call = (native.arow_reference_rowloop if name == "arow"
-                      else lambda *a, **kw: native.fm_reference_rowloop(
-                          *a, k=k, **kw))
         # probe on st itself: detects missing symbols AND warms the model
         # table allocation so it never lands inside the timed window
-        if probe_call(idx[:2048], val[:2048], lab[:2048], dims,
-                      state=st) is None:
+        if rowloop(idx[:2048], val[:2048], lab[:2048], st) is None:
             continue
         t0 = time.perf_counter()
         done = 0
         while time.perf_counter() - t0 < budget_s:
-            call(st)
+            rowloop(idx, val, lab, st)
             done += n
         out[f"{name}_rows_per_sec"] = round(
             done / (time.perf_counter() - t0), 1)

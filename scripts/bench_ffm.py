@@ -31,7 +31,8 @@ def main() -> None:
                      num_fields=fields, seed=0)
 
     rng = np.random.RandomState(0)
-    idx = (rng.zipf(1.3, size=(n_blocks, batch, width)) % (1 << 20)).astype(np.int32)
+    from hivemall_tpu.runtime.benchmark import make_workload_ids as make_ids
+    idx = make_ids(rng, (n_blocks, batch, width), dims=1 << 20)
     val = np.ones((n_blocks, batch, width), dtype=np.float32)
     fld = rng.randint(0, fields, size=(n_blocks, batch, width)).astype(np.int32)
     lab = np.sign(rng.randn(n_blocks, batch)).astype(np.float32)

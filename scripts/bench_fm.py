@@ -27,7 +27,8 @@ def main() -> None:
     n_blocks = 8
 
     rng = np.random.RandomState(0)
-    idx = (rng.zipf(1.3, size=(n_blocks, batch, width)) % dims).astype(np.int32)
+    from hivemall_tpu.runtime.benchmark import make_workload_ids as make_ids
+    idx = make_ids(rng, (n_blocks, batch, width), dims=dims)
     val = np.ones((n_blocks, batch, width), dtype=np.float32)
     lab = np.sign(rng.randn(n_blocks, batch)).astype(np.float32)
     no_va = np.zeros((batch,), dtype=bool)

@@ -30,7 +30,8 @@ def main() -> None:
     L, dims, batch, width, n_blocks = 26, 1 << 20, 4096, 64, 8
 
     rng = np.random.RandomState(0)
-    idx = (rng.zipf(1.3, size=(n_blocks, batch, width)) % dims).astype(np.int32)
+    from hivemall_tpu.runtime.benchmark import make_workload_ids as make_ids
+    idx = make_ids(rng, (n_blocks, batch, width), dims=dims)
     val = np.ones((n_blocks, batch, width), dtype=np.float32)
     lab = rng.randint(0, L, size=(n_blocks, batch)).astype(np.int32)
 

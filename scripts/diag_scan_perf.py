@@ -230,9 +230,9 @@ def main():
         # the headline workload shape (bench.make_ids): log-uniform
         # frequency, hash-uniform placement — so section B/C epoch numbers
         # transfer to what bench.py actually times
-        from bench import make_ids
+        from hivemall_tpu.runtime.benchmark import make_workload_ids as make_ids
 
-        idx = make_ids(rng, (n, BATCH, WIDTH))
+        idx = make_ids(rng, (n, BATCH, WIDTH), dims=DIMS)
         val = np.ones((n, BATCH, WIDTH), dtype=np.float32)
         lab = np.sign(rng.randn(n, BATCH)).astype(np.float32)
         return jnp.asarray(idx), jnp.asarray(val), jnp.asarray(lab)
