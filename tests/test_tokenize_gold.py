@@ -61,6 +61,20 @@ def test_heldout_f1_gate():
     assert m["f1"] >= 0.9, m
 
 
+def test_blind2_f1_gate():
+    """Round-4b third fixture, measured BLIND first against the grown
+    (3043-surface) lexicon: first-pass span F1 0.9773 — the number PERF.md
+    records as the open-domain estimate for this lexicon generation (up
+    from 0.872 for the previous one). After its three OOV misses (口座,
+    毎週, について) were folded it joins the regression floor."""
+    blind2 = load_gold(os.path.join(os.path.dirname(__file__), "data",
+                                    "tokenize_ja_blind2.tsv"))
+    assert len(blind2) >= 30
+    pairs = [(toks, tokenize_ja(sent)) for sent, toks in blind2]
+    m = segmentation_prf(pairs)
+    assert m["f1"] >= 0.95, m
+
+
 def test_bulk_path_scores_identically(gold):
     """The native bulk Viterbi must score exactly like the per-text path
     on the whole fixture (segmentation parity at corpus scale)."""
