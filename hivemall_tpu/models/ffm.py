@@ -34,6 +34,7 @@ import numpy as np
 from flax import struct
 
 from ..core.batch import pad_to_bucket
+from ..ops.scatter import scatter_rows_flat
 from ..ops.convergence import ConversionState
 from ..ops.eta import EtaEstimator, get_eta
 from ..utils.feature import FMFeature
@@ -266,8 +267,8 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
             p, g, loss, keys, dV, dgg = row_updates(st, idx, val, fld, y, t)
             widx, wval = (idx, val) if translate_w is None \
                 else translate_w(idx, val)
-            v = st.v.at[keys.reshape(-1)].add(
-                dV.reshape(-1, dV.shape[-1]), mode="drop")
+            v = scatter_rows_flat(
+                st.v, keys.reshape(-1), dV.reshape(-1, dV.shape[-1]))
             v_gg = st.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1),
                                                     mode="drop")
             st = st.replace(v=v, v_gg=v_gg, step=st.step + 1)
@@ -301,8 +302,8 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
             else jax.vmap(translate_w)(idx, val)
         k = dV.shape[-1]
         carry = carry.replace(
-            v=carry.v.at[keys.reshape(-1)].add(dV.reshape(-1, k),
-                                               mode="drop"),
+            v=scatter_rows_flat(carry.v, keys.reshape(-1),
+                                 dV.reshape(-1, k)),
             v_gg=carry.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1),
                                                      mode="drop"),
         )

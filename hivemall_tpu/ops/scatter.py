@@ -122,3 +122,32 @@ def dedup_scatter_set_uniform(table: jnp.ndarray, plan: DedupPlan,
     return table.at[plan.rep].set(out.astype(table.dtype), mode="drop",
                                   unique_indices=True,
                                   indices_are_sorted=True)
+
+
+def scatter_rows_flat(table: jnp.ndarray, keys: jnp.ndarray,
+                      upd: jnp.ndarray,
+                      _flat_limit: int = 2**31) -> jnp.ndarray:
+    """Row scatter-add via the flat scalar view.
+
+    A [N,k]-row scatter into [E,k] measured ~2x slower on v5e than the same
+    updates scattered as scalars into the flat [E*k] view (diag micro2
+    scatter_v5_flat 36.9ms vs scatter_v5_rows 71.2ms per 512k rows; 8-lane
+    padding does NOT rescue the row form — v8pad 69.1ms). `upd`'s last dim
+    may carry fewer lanes than the table (k_logical <= k, e.g. FM's padded
+    V): only those lanes are scattered, so pad lanes stay untouched. Drop
+    semantics are preserved: pad keys (>= E) flatten to >= E*k.
+
+    Falls back to the row form when E*k would overflow the int32 flat-index
+    space (the flat product wraps negative and mode="drop" would silently
+    discard every update). `_flat_limit` exists so tests can exercise the
+    fallback branch at small table sizes.
+    """
+    e, k = table.shape
+    kl = upd.shape[-1]
+    if e * k < _flat_limit:
+        fidx = keys[..., None] * k + jnp.arange(kl)
+        return table.reshape(-1).at[fidx].add(upd, mode="drop").reshape(e, k)
+    if kl != k:
+        upd = jnp.concatenate(
+            [upd, jnp.zeros(upd.shape[:-1] + (k - kl,), upd.dtype)], axis=-1)
+    return table.at[keys].add(upd, mode="drop")
