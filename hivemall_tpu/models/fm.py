@@ -74,12 +74,18 @@ class FMHyper:
     @property
     def padded_factors(self) -> int:
         """Physical lane count of the V table: k rounded up to a multiple
-        of 8 when k > 4 (TPU f32 sublane granularity is 8; a [N, 5]-row
-        gather/scatter measured ~9x the per-row cost of an aligned one —
-        diag_scan_perf micro2 on v5e). Pad lanes init to 0 and provably
-        stay 0 (their grad terms are products with their own zero V
-        entries), so every k-width result is bit-identical; model_rows /
-        codecs slice back to the logical k."""
+        of 8 when k > 4 (TPU f32 sublane granularity). Hardware note: the
+        round-4b hypothesis that lane alignment rescues the [N,k]-ROW
+        scatter was refuted on v5e (diag micro2: v8pad row scatter 69ms ==
+        v5 row scatter 71ms per 512k rows) — the V update now scatters
+        scalars into the flat [D*kp] view instead (ops/scatter.
+        scatter_rows_flat, ~2x the row form on unaligned tables), touching
+        only the logical k lanes. Padding is kept for tile-aligned
+        storage/gather at zero measured cost (row gather 28.5M/s == padded
+        28.2M/s). Pad lanes init to 0 and provably stay 0 (their grad
+        terms are products with their own zero V entries and their
+        lambda_v is 0), so every k-width result is bit-identical;
+        model_rows / codecs slice back to the logical k."""
         k = self.factors
         if k > 4 and k % 8:
             return k + (8 - k % 8)
