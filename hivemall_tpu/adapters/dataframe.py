@@ -238,10 +238,11 @@ class HivemallFrame:
         import pandas as pd
 
         df = self._df.sort_values(group_col, kind="mergesort")
-        rows_in = ((r[group_col], r[value_col], tuple(r))
+        # NB: tuple(dict) yields the KEYS — the payload must carry the row
+        # VALUES (caught by tests/test_spark_adapter.py)
+        rows_in = ((r[group_col], r[value_col], tuple(r.values()))
                    for r in df.to_dict("records"))
-        out = [(rank, value) + tuple(payload.values() if isinstance(payload, dict)
-                                     else payload)
+        out = [(rank, value) + tuple(payload)
                for rank, value, payload in etk(k, rows_in)]
         cols = ["rank", "value"] + list(df.columns)
         return self._wrap(pd.DataFrame(out, columns=cols))

@@ -173,59 +173,19 @@ def _run_mf_trainer(trainer: str, fn, options: Optional[str], src: IO[str],
 
 
 def _emit_model_rows(trainer: str, model, out: IO[str]) -> None:
-    from ..models.ffm import TrainedFFMModel
-    from ..models.fm import TrainedFMModel
-    from ..models.trees.forest import TrainedForest, TrainedGBT
+    """TSV rendering of the shared typed row iteration (adapters/
+    model_rows.iter_model_rows — the ONE copy of the family dispatch).
+    List-valued cells (FM Vif, importances, opcode programs) render as
+    JSON text, everything else through _fmt (None -> \\N)."""
+    from .model_rows import iter_model_rows
 
-    if isinstance(model, TrainedGBT):
-        # per-(round, class) rows, the reference's per-round forward
-        # (GradientTreeBoostingClassifierUDTF.java:525-546) + a classes
-        # JSON column (this trainer accepts arbitrary labels where the
-        # reference requires 0..K-1 indices)
-        for m, c, mt, text, ic, sh, imp, oob, vocab in model.model_rows():
-            _emit(out, int(m), int(c), str(mt), text, float(ic),
-                  float(sh), json.dumps(imp), oob, vocab)
-        return
-
-    if isinstance(model, TrainedFMModel):
-        w0, feats, w, v = model.model_rows()
-        _emit(out, -1, float(w0), None)
-        for f, wi, vi in zip(feats, w, v):
-            _emit(out, int(f), float(wi),
-                  json.dumps([float(x) for x in vi]))
-    elif isinstance(model, TrainedFFMModel):
-        # joinable linear part (w0 on -1) PLUS the complete model as one
-        # base91 text blob row on feature -2 — the reference ships FFM
-        # models as compressed text blobs the same way
-        # (fm/FFMPredictionModel.java:46-200); predict_ffm consumes it
-        from ..tools import base91
-
-        feats, w, w0 = model.model_rows()
-        _emit(out, -1, float(w0), None)
-        for f, wi in zip(feats, w):
-            _emit(out, int(f), float(wi), None)
-        _emit(out, -2, None, base91(model.to_blob()))
-    elif isinstance(model, TrainedForest):
-        for mid, mtype, text, imp, oe, ot in model.model_rows():
-            _emit(out, int(mid), str(mtype),
-                  text if isinstance(text, str) else json.dumps(text),
-                  json.dumps(imp), int(oe), int(ot))
-    elif hasattr(model, "label_vocab"):  # multiclass family
-        rows = model.model_rows()
-        for tup in zip(*rows):
-            _emit(out, *tup)
-    elif hasattr(model, "state") and hasattr(model.state, "weights"):
-        from ..core.state import model_rows
-
-        rows = model_rows(model.state)
-        if len(rows) == 3 and rows[2] is not None:
-            for f, w, c in zip(*rows):
-                _emit(out, int(f), float(w), float(c))
-        else:
-            for f, w in zip(rows[0], rows[1]):
-                _emit(out, int(f), float(w))
-    else:
-        raise ValueError(f"{trainer}: model has no row emission")
+    # iter_model_rows raises its own descriptive ValueError for models
+    # without row emission; don't catch-and-relabel (it would mask data
+    # errors from the eager family branches as "no row emission")
+    _, rows = iter_model_rows(model)
+    for row in rows:
+        _emit(out, *(json.dumps(c) if isinstance(c, list) else c
+                     for c in row))
 
 
 # ---------------------------------------------------------------- predicting
