@@ -210,6 +210,11 @@ class SparkHivemallOps:
                 f"{name} takes (user, item, rating) rows — use the Hive "
                 "TRANSFORM bridge (adapters/hive_transform.py) or the "
                 "direct API (models/mf.py) for matrix factorization")
+        # fail fast on the driver: a typo'd trainer name must not surface
+        # as an executor task failure after the job launches
+        from ..sql import get_function
+
+        get_function(name)
         mix = self._mix_servs
         schema = model_row_schema(name)
 

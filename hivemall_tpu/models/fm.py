@@ -193,23 +193,40 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
     if feature_shard is not None and hyper.adareg:
         raise ValueError("adareg is not supported with feature_shard")
 
+    # Borrowed-lane packing (minibatch local path): when V is lane-padded
+    # (kp > k), the first pad lane carries w for the block — ONE [K,kp]
+    # row gather replaces the separate w gather, and dw rides the same
+    # flat row scatter as dv (one ~0.1ms full-table lane write each way
+    # vs a ~13ms gather + ~7ms scatter saved per 512k-update block on
+    # v5e). The pad-lane-zero invariant holds on the canonical state: the
+    # lane is zeroed again at unpack.
+    w_lane = hyper.factors
+    use_packed = (feature_shard is None
+                  and hyper.padded_factors > hyper.factors)
+
     if feature_shard is None:
-        def gather_and_predict(state: FMState, idx, val):
-            wg = state.w.at[idx].get(mode="fill", fill_value=0.0)
-            vg = state.v.at[idx].get(mode="fill", fill_value=0.0)
+        def gather_and_predict(state: FMState, idx, val, packed=None):
+            if packed is not None:
+                pg = packed.at[idx].get(mode="fill", fill_value=0.0)
+                wg = pg[:, w_lane]
+                vg = pg.at[:, w_lane].set(0.0)  # restore the pad-lane zero
+            else:
+                wg = state.w.at[idx].get(mode="fill", fill_value=0.0)
+                vg = state.v.at[idx].get(mode="fill", fill_value=0.0)
             p, sum_vfx = _row_predict(state.w0, wg, vg, val)
             return wg, vg, val, idx, p, sum_vfx
     else:
         shard_axis, stripe = feature_shard
 
-        def gather_and_predict(state: FMState, idx, val):
+        def gather_and_predict(state: FMState, idx, val, packed=None):
             wg, vg, vmask, lidx, p, sum_vfx = sharded_gather_predict(
                 state.w, state.v, state.w0, idx, val, shard_axis, stripe)
             return wg, vg, vmask, lidx, p, sum_vfx
 
-    def row_deltas(state: FMState, idx, val, y, t):
+    def row_deltas(state: FMState, idx, val, y, t, packed=None):
         eta = hyper.eta.eta(t)
-        wg, vg, eff_val, sidx, p, sum_vfx = gather_and_predict(state, idx, val)
+        wg, vg, eff_val, sidx, p, sum_vfx = gather_and_predict(
+            state, idx, val, packed)
         g, loss = _dloss_and_loss(p, y, hyper)
         dw0 = -eta * (g + 2.0 * state.lambda_w0 * state.w0)
         dw = -eta * (g * eff_val + 2.0 * state.lambda_w * wg)
@@ -263,9 +280,10 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
     def minibatch_step(state: FMState, indices, values, labels, va_mask):
         b = indices.shape[0]
         ts = (state.step + 1 + jnp.arange(b)).astype(jnp.float32)
+        packed = (state.v.at[:, w_lane].set(state.w) if use_packed else None)
 
         def per_row(idx, val, y, t):
-            return row_deltas(state, idx, val, y, t)
+            return row_deltas(state, idx, val, y, t, packed)
 
         dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta, sidx = jax.vmap(per_row)(
             indices, values, labels, ts)
@@ -278,20 +296,47 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
             # entries), so scatter those and pad lanes stay provably zero.
             return scatter_rows_flat(v_table, sidx, upd[..., : hyper.factors])
 
+        # accumulate in f32 even if the tables ever go compact (same
+        # store-compact/accumulate-wide policy as core/engine.py)
+        acc_w = jnp.promote_types(state.w.dtype, jnp.float32)
+        acc_v = jnp.promote_types(state.v.dtype, jnp.float32)
         if mini_batch_average:
+            # FloatAccumulator denominators (shared by the packed and
+            # unpacked apply below): per-feature touch counts, w0 by the
+            # effective batch size
+            counts = jnp.zeros((state.w.shape[0],), jnp.float32).at[sidx].add(
+                jnp.broadcast_to(theta[:, None], sidx.shape), mode="drop")
+            denom = jnp.maximum(counts, 1.0)
+
+        if use_packed:
+            # dw rides lane w_lane of the same flat row scatter as dv
+            k_log = hyper.factors
+            upd = jnp.concatenate([dv[..., :k_log], dw[..., None]], axis=-1)
+            if mini_batch_average:
+                acc = scatter_rows_flat(jnp.zeros(state.v.shape, acc_v),
+                                        sidx,
+                                        theta[:, None, None]
+                                        * upd.astype(acc_v))
+                new_w = (state.w.astype(acc_v) + acc[:, w_lane] / denom) \
+                    .astype(state.w.dtype)
+                new_v = (state.v.astype(acc_v)
+                         + acc.at[:, w_lane].set(0.0) / denom[:, None]) \
+                    .astype(state.v.dtype)
+                new_w0 = state.w0 + jnp.sum(theta * dw0) / jnp.maximum(
+                    jnp.sum(theta), 1.0)
+            else:
+                pk = scatter_rows_flat(packed, sidx,
+                                       theta[:, None, None] * upd)
+                new_w = pk[:, w_lane]
+                new_v = pk.at[:, w_lane].set(0.0)
+                new_w0 = state.w0 + jnp.sum(theta * dw0)
+        elif mini_batch_average:
             # FloatAccumulator semantics via full-table delta temporaries +
             # one elementwise apply: scattering counts and delta SUMS then
             # dividing table-wide costs ~0.5ms of HBM streaming, vs ~13ms
             # for the per-lane denominator GATHER the pre-divided variant
             # needs (diag micro gather rate on v5e) — same math, the
             # denominators just divide at the table instead of the lanes.
-            counts = jnp.zeros((state.w.shape[0],), jnp.float32).at[sidx].add(
-                jnp.broadcast_to(theta[:, None], sidx.shape), mode="drop")
-            denom = jnp.maximum(counts, 1.0)
-            # accumulate in f32 even if the tables ever go compact (same
-            # store-compact/accumulate-wide policy as core/engine.py)
-            acc_w = jnp.promote_types(state.w.dtype, jnp.float32)
-            acc_v = jnp.promote_types(state.v.dtype, jnp.float32)
             dw_sum = jnp.zeros(state.w.shape, acc_w).at[sidx].add(
                 theta[:, None] * dw.astype(acc_w), mode="drop")
             new_w = (state.w.astype(acc_w) + dw_sum / denom) \

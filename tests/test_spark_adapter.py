@@ -231,3 +231,9 @@ def test_grouped_value_coercion_for_spark_types():
     ml = ops.groupby("g").max_label("score", "label",
                                     key_type="string").toPandas()
     assert all(isinstance(v, str) for v in ml["value"])  # declared string
+
+
+def test_unknown_trainer_fails_on_driver():
+    df = _two_partition_df()
+    with pytest.raises(Exception):  # eager registry lookup, no job launch
+        spark_hivemall_ops(df).train_adagrad  # typo of train_adagrad_rda
