@@ -116,10 +116,13 @@ def _row_pair_keys(idx, fields, dv):
                      .astype(jnp.uint32), dv)
 
 
-def _row_predict(state: FFMState, idx, val, fields, hyper: FFMHyper):
+def _row_predict(state: FFMState, idx, val, fields, hyper: FFMHyper,
+                 Vg=None, keys=None):
     K = idx.shape[0]
-    keys = _row_pair_keys(idx, fields, hyper.v_dims)  # [K, K]
-    Vg = state.v[keys]  # [K, K, k]
+    if keys is None:
+        keys = _row_pair_keys(idx, fields, hyper.v_dims)  # [K, K]
+    if Vg is None:
+        Vg = state.v[keys]  # [K, K, k]
     # pair mask: i < j and both lanes real (padded lanes have val 0)
     iu = jnp.triu_indices(K, 1)
     inter = jnp.einsum("ijf,jif->ij", Vg, Vg)  # <V_{i,fj}, V_{j,fi}>
@@ -168,6 +171,7 @@ def sharded_ffm_gather(st: FFMState, idx, val, fields, hyper: FFMHyper,
 def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
                   row_chunk: Optional[int] = None,
                   feature_shard: Optional[Tuple[str, int, int]] = None,
+                  pack_v: Optional[bool] = None,
                   jit: bool = True):
     """`row_chunk` (minibatch mode only) tiles the batch's K^2 pairwise work:
     the [B, K, K, k] dV / [B, K, K] gg activations are the FFM memory hot
@@ -191,9 +195,20 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
     if feature_shard is None:
         translate_w = None
 
-        def predict_gather(st: FFMState, idx, val, fields):
-            p, keys, Vg, xx = _row_predict(st, idx, val, fields, hyper)
-            gg = st.v_gg[keys]
+        def predict_gather(st: FFMState, idx, val, fields, packed=None):
+            if packed is None:
+                p, keys, Vg, xx = _row_predict(st, idx, val, fields, hyper)
+                gg = st.v_gg[keys]
+            else:
+                # v+gg interleaved [Dv, k+1]: ONE [K,K]-row gather yields
+                # both — the separate scalar gg gather (K^2 scalars/row)
+                # rides the V row gather for free (same borrowed-lane
+                # pattern as FM; v5e cost model in PERF.md round 4c)
+                keys = _row_pair_keys(idx, fields, hyper.v_dims)
+                pg = packed[keys]  # [K, K, k+1]
+                Vg, gg = pg[..., :-1], pg[..., -1]
+                p, _, _, xx = _row_predict(st, idx, val, fields, hyper,
+                                           Vg=Vg, keys=keys)
             own = jnp.ones(keys.shape, val.dtype)
             return p, keys, Vg, xx, gg, own
     else:
@@ -204,7 +219,7 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         def translate_w(idx, val):
             return translate_to_stripe(idx, val, shard_axis, stripe_w)
 
-        def predict_gather(st: FFMState, idx, val, fields):
+        def predict_gather(st: FFMState, idx, val, fields, packed=None):
             return sharded_ffm_gather(st, idx, val, fields, hyper,
                                       shard_axis, stripe_w, stripe_v)
 
@@ -215,8 +230,9 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         pc = jnp.clip(p, hyper.min_target, hyper.max_target)
         return pc - y, 0.5 * (pc - y) ** 2
 
-    def row_updates(st: FFMState, idx, val, fields, y, t):
-        p, keys, Vg, xx, gg, own = predict_gather(st, idx, val, fields)
+    def row_updates(st: FFMState, idx, val, fields, y, t, packed=None):
+        p, keys, Vg, xx, gg, own = predict_gather(st, idx, val, fields,
+                                                  packed)
         g, loss = dloss_fn(p, y)
         K = idx.shape[0]
         # dV[i, j] = g * x_i x_j * V_{j, f_i} for i != j
@@ -290,23 +306,33 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         return state, jnp.sum(losses)
 
     def apply_row_group(carry: FFMState, base: FFMState, idx, val, fld, lab,
-                        ts):
+                        ts, pk_carry=None, pk_base=None):
         """Compute one row group's updates against the block-start `base`
         parameters and scatter-accumulate them into `carry` — the single
         accumulate-then-apply body shared by the unchunked minibatch step
-        (carry == base, one group) and the tiled step (scan over groups)."""
+        (carry == base, one group) and the tiled step (scan over groups).
+
+        With `pk_base`/`pk_carry` (local path), V and gg live interleaved
+        in one [Dv, k+1] table for the block: gathers and scatters each
+        collapse to a single row op; carry.v / carry.v_gg are STALE inside
+        and the caller unpacks at block end."""
         p, g, loss, keys, dV, dgg = jax.vmap(
-            lambda i, v, f, y, t: row_updates(base, i, v, f, y, t))(
+            lambda i, v, f, y, t: row_updates(base, i, v, f, y, t, pk_base))(
                 idx, val, fld, lab, ts)
         widx, wval = (idx, val) if translate_w is None \
             else jax.vmap(translate_w)(idx, val)
         k = dV.shape[-1]
-        carry = carry.replace(
-            v=scatter_rows_flat(carry.v, keys.reshape(-1),
-                                 dV.reshape(-1, k)),
-            v_gg=carry.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1),
-                                                     mode="drop"),
-        )
+        if pk_carry is not None:
+            upd = jnp.concatenate([dV, dgg[..., None]], axis=-1)
+            pk_carry = scatter_rows_flat(pk_carry, keys.reshape(-1),
+                                         upd.reshape(-1, k + 1))
+        else:
+            carry = carry.replace(
+                v=scatter_rows_flat(carry.v, keys.reshape(-1),
+                                    dV.reshape(-1, k)),
+                v_gg=carry.v_gg.at[keys.reshape(-1)].add(dgg.reshape(-1),
+                                                         mode="drop"),
+            )
         if hyper.linear_coeff:
             dz, dn, w_new = jax.vmap(
                 lambda i, v_, g_, t: w_updates(base, i, v_, g_, t))(
@@ -318,7 +344,7 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
             )
         carry = carry.replace(touched=carry.touched.at[widx].max(
             jnp.ones_like(widx, dtype=jnp.int8), mode="drop"))
-        return carry, jnp.sum(loss), jnp.sum(g)
+        return carry, jnp.sum(loss), jnp.sum(g), pk_carry
 
     def apply_w0(st: FFMState, base: FFMState, g_sum, b, t_last):
         # one batch-level w0 update with eta at the batch's final timestep
@@ -328,11 +354,31 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
         return st.replace(w0=base.w0 - eta * (
             g_sum + b * 2.0 * hyper.lambda_w * base.w0))
 
+    def _want_pack(b: int, K: int, state: FFMState) -> bool:
+        """Packing costs ~2 full [Dv, k+1] table passes per block; the win
+        is the B*K^2 random-scalar gg gather+scatter it absorbs into the V
+        row ops. Pack only when the block's pairwise volume dominates the
+        table traffic (always true at the deployment block sizes; tiny
+        test minibatches stay on the split path). `pack_v` overrides."""
+        if feature_shard is not None:
+            return False
+        if pack_v is not None:
+            return pack_v
+        return b * K * K * 8 >= state.v.shape[0]
+
+    def _pack_v(state: FFMState):
+        return jnp.concatenate([state.v, state.v_gg[:, None]], axis=1)
+
     def minibatch_step(state: FFMState, indices, values, fields, labels):
         b = indices.shape[0]
         ts = (state.step + 1 + jnp.arange(b)).astype(jnp.float32)
-        st, loss, g_sum = apply_row_group(state, state, indices, values,
-                                          fields, labels, ts)
+        pk = _pack_v(state) if _want_pack(b, indices.shape[1], state) \
+            else None
+        st, loss, g_sum, pk = apply_row_group(state, state, indices, values,
+                                              fields, labels, ts,
+                                              pk_carry=pk, pk_base=pk)
+        if pk is not None:
+            st = st.replace(v=pk[:, :-1], v_gg=pk[:, -1])
         st = apply_w0(st, state, g_sum, b, ts[-1])
         return st.replace(step=state.step + b), loss
 
@@ -346,14 +392,21 @@ def make_ffm_step(hyper: FFMHyper, mode: str = "scan",
             (indices, values, fields, labels))
         ts_all = (state.step + 1 + jnp.arange(b)).astype(jnp.float32) \
             .reshape(b // c, c)
+        pk0 = _pack_v(state) if _want_pack(b, indices.shape[1], state) \
+            else None
 
-        def body(st, chunk_in):
+        def body(carry, chunk_in):
+            st, pk = carry
             idx, val, fld, lab, ts = chunk_in
-            st, loss, g_sum = apply_row_group(st, state, idx, val, fld, lab,
-                                              ts)
-            return st, (loss, g_sum)
+            st, loss, g_sum, pk = apply_row_group(st, state, idx, val, fld,
+                                                  lab, ts, pk_carry=pk,
+                                                  pk_base=pk0)
+            return (st, pk), (loss, g_sum)
 
-        st, (losses, g_sums) = jax.lax.scan(body, state, (*chunks, ts_all))
+        (st, pk), (losses, g_sums) = jax.lax.scan(
+            body, (state, pk0), (*chunks, ts_all))
+        if pk is not None:
+            st = st.replace(v=pk[:, :-1], v_gg=pk[:, -1])
         st = apply_w0(st, state, jnp.sum(g_sums), b, ts_all[-1, -1])
         return st.replace(step=state.step + b), jnp.sum(losses)
 

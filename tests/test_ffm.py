@@ -115,3 +115,32 @@ def test_pair_hash_deterministic():
     c = FFM.pair_hash(jnp.array([7], dtype=jnp.uint32), jnp.array([5], dtype=jnp.uint32),
                       1 << 20)
     assert int(a[0]) != int(c[0])  # order matters: (i, fj) != (j, fi)
+
+
+def test_ffm_packed_v_exact_vs_split():
+    """The borrowed-lane V+gg packing (one [Dv, k+1] row gather/scatter per
+    block) must reproduce the split-table path exactly, in both the
+    unchunked and the K^2-tiled minibatch steps."""
+    import jax
+
+    from hivemall_tpu.models.ffm import (FFMHyper, _stage_ffm_rows,
+                                         init_ffm_state, make_ffm_step)
+
+    rows, y = _gen_ffm_data(n=256)
+    hyper = FFMHyper(factors=4, num_features=1 << 18, v_dims=1 << 18, seed=3,
+                     global_bias=True)
+    idx, val, fld, lab = _stage_ffm_rows(rows, y, hyper)
+
+    for chunk in (None, 32):
+        split = make_ffm_step(hyper, "minibatch", row_chunk=chunk,
+                              pack_v=False)
+        packed = make_ffm_step(hyper, "minibatch", row_chunk=chunk,
+                               pack_v=True)
+        s1, l1 = split(init_ffm_state(hyper), idx, val, fld, lab)
+        s2, l2 = packed(init_ffm_state(hyper), idx, val, fld, lab)
+        assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+        h1, h2 = jax.device_get(s1), jax.device_get(s2)
+        np.testing.assert_allclose(h2.v, h1.v, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(h2.v_gg, h1.v_gg, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(h2.w, h1.w, rtol=1e-6, atol=1e-8)
+        assert float(h2.w0) == pytest.approx(float(h1.w0), abs=1e-7)
