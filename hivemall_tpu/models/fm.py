@@ -168,6 +168,7 @@ def _dloss_and_loss(p, y, hyper: FMHyper):
 def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
                  mini_batch_average: bool = True,
                  feature_shard: Optional[Tuple[str, int]] = None,
+                 pack_w: bool = True,
                  jit: bool = True):
     """Jitted FM block update. scan = reference-exact sequential; minibatch =
     accumulate-then-apply against block-start parameters.
@@ -201,8 +202,12 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
     # v5e). The pad-lane-zero invariant holds on the canonical state: the
     # lane is zeroed again at unpack.
     w_lane = hyper.factors
+    # pack_w=False forces the split path (parity tests A/B it); packing
+    # additionally requires a free pad lane (kp > k) and the local
+    # (unsharded) path — without either it silently runs split
     use_packed = (feature_shard is None
-                  and hyper.padded_factors > hyper.factors)
+                  and hyper.padded_factors > hyper.factors
+                  and pack_w)
 
     if feature_shard is None:
         def gather_and_predict(state: FMState, idx, val, packed=None):
