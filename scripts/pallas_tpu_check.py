@@ -50,6 +50,15 @@ def main():
                                    np.asarray(ref.weights), rtol=1e-4, atol=1e-5)
         assert abs(float(got_loss) - float(ref_loss)) < 1e-3 + 1e-4 * abs(float(ref_loss))
         print(f"{rule.name}: compiled, matches engine scan")
+        n_verified = i + 2  # + the AROW case above
+
+    # partial-progress line: a relay drop during the (long) timing runs
+    # below must still leave the correctness result published
+    import json
+    print(json.dumps({
+        "metric": "pallas_rule_families_hardware_verified_tpu",
+        "value": n_verified, "unit": "rule_families",
+    }), flush=True)
 
     # throughput: sequential semantics, Pallas VMEM kernel vs engine HBM scan
     B, K, Dbig = 4096, 16, 1 << 18
@@ -60,23 +69,39 @@ def main():
     y = jnp.asarray(np.sign(rng.randn(B)).astype(np.float32))
 
     def timeit(step, st):
+        # verified sync: end every timed window with a VALUE FETCH of a
+        # scalar carried through the step chain — block_until_ready
+        # through the axon relay can acknowledge before execution
+        # finishes (PERF.md round-4b retraction)
         st2, loss = step(st, idx, val, y)
-        jax.block_until_ready(loss)
+        float(loss)
         t0 = time.perf_counter()
         n = 10
         for _ in range(n):
             st2, loss = step(st2, idx, val, y)
-        jax.block_until_ready(loss)
+        float(loss)
         return (time.perf_counter() - t0) / n
 
     eng = timeit(make_train_step(AROW, {"r": 0.1}, mode="scan", donate=False),
                  init_linear_state(Dbig, use_covariance=True))
+    print(json.dumps({
+        "metric": "engine_scan_arow_seq_4096x16_2^18_tpu",
+        "value": round(B / eng, 1), "unit": "rows/sec",
+        "ms_per_block": round(eng * 1e3, 3),
+    }), flush=True)
     pal = timeit(make_pallas_scan_step(AROW, {"r": 0.1}),
                  init_linear_state(Dbig, use_covariance=True))
     print(f"sequential AROW [B={B},K={K},D=2^18]: engine scan "
           f"{eng*1e3:.1f} ms/block ({B/eng:,.0f} rows/s), pallas "
           f"{pal*1e3:.1f} ms/block ({B/pal:,.0f} rows/s), "
           f"speedup {eng/pal:.1f}x")
+    print(json.dumps({
+        "metric": "pallas_vmem_scan_arow_seq_4096x16_2^18_tpu",
+        "value": round(B / pal, 1), "unit": "rows/sec",
+        "engine_scan_rows_per_sec": round(B / eng, 1),
+        "speedup_vs_engine_scan": round(eng / pal, 2),
+        "ms_per_block": round(pal * 1e3, 3),
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -101,7 +101,7 @@ run_one() {  # run_one <tag> <cmd...>
 
 all_done() {
   for t in diag_micro diag_arow diag_fm diag_micro2 ctr_e2e fm ffm mc mf \
-           methodology forest arow1 arow2; do
+           methodology pallas forest arow1 arow2; do
     [ -e "$DONE_DIR/$t" ] || return 1
   done
 }
@@ -126,6 +126,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_one mc      python -u scripts/bench_mc.py
     run_one mf      python -u scripts/bench_mf.py
     run_one methodology python -u scripts/bench_arow_methodology.py
+    run_one pallas  python -u scripts/pallas_tpu_check.py
     run_one forest  python -u scripts/bench_forest.py
     run_one ctr_e2e python -u scripts/bench_ctr_e2e.py \
       --train-rows 2097152 --test-rows 262144 --epochs-arow 4 --epochs-fm 4
