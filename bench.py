@@ -146,6 +146,21 @@ def _measure() -> None:
         "arow_rows_per_sec": round(arow_rps, 1),
         "fm_rows_per_sec": round(fm_rps, 1),
     }
+    if platform == "tpu":
+        # A/B the sorted-window MXU update backend (ops/mxu_scatter.py) in
+        # the same window — the default stays whichever side this data says
+        # (r4c keep-or-revert policy)
+        fn_mxu = make_train_fn(AROW, {"r": 0.1}, mode="minibatch",
+                               update_backend="mxu")
+        out["arow_mxu_rows_per_sec"] = round(timed_epoch_loop(
+            make_epoch(fn_mxu),
+            init_linear_state(DIMS, use_covariance=True)), 1)
+        fm_fn_mxu = make_fm_step(hyper, mode="minibatch", jit=False,
+                                 update_backend="mxu")
+        fm_epoch_mxu = make_epoch(
+            lambda s, bi, bv, bl: fm_fn_mxu(s, bi, bv, bl, no_va))
+        out["fm_mxu_rows_per_sec"] = round(
+            timed_epoch_loop(fm_epoch_mxu, init_fm_state(DIMS, hyper)), 1)
     if platform == "cpu":
         # the framework's host execution backend (-native_scan): exact
         # sequential epochs through the C row loop over the same staged
@@ -202,34 +217,73 @@ def _run_child(env_overrides: dict, timeout: float):
     return None
 
 
-def _probe_tpu(timeout: float = 75.0) -> bool:
-    """Cheap child probe: is the axon relay serving? A dead relay hangs
+def _probe_tpu(timeout: float = 75.0) -> str:
+    """Cheap child probe. Returns 'tpu' (relay serving), 'cpu' (jax came up
+    but on a host backend — no TPU is configured for this process, so
+    waiting longer cannot help), or 'dead' (backend init hung or crashed —
+    the relay is configured but not serving right now). A dead relay hangs
     backend init, so a full measurement attempt against it wastes its whole
-    timeout — probe first and skip straight to CPU when it's down."""
-    code = ("import jax; import sys; "
-            "sys.exit(0 if jax.devices()[0].platform == 'tpu' else 1)")
+    timeout — probe first."""
+    code = "import jax; print('PLATFORM:' + jax.devices()[0].platform)"
     try:
         proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, timeout=timeout,
+                              capture_output=True, text=True, timeout=timeout,
                               cwd=os.path.dirname(os.path.abspath(__file__)))
-        return proc.returncode == 0
     except (subprocess.TimeoutExpired, OSError):
-        return False
+        return "dead"
+    for line in proc.stdout.splitlines():
+        if line.startswith("PLATFORM:"):
+            plat = line.split(":", 1)[1].strip()
+            return "tpu" if plat == "tpu" else "cpu"
+    return "dead"
+
+
+def _acquire_tpu_measurement() -> "dict | None":
+    """Budget-bounded relay acquisition (VERDICT r4 weak #4): the relay's
+    observed duty cycle is uptime windows of minutes separated by hours, so
+    two probes at invocation time almost always miss it and the driver
+    artifact records the CPU fallback. Instead, probe every ~2 minutes for
+    up to HIVEMALL_TPU_BENCH_TPU_ACQUIRE_S seconds (default 2400) and run
+    the measurement inside the first window that serves. A probe that lands
+    on a *host* backend exits the loop immediately — no relay is configured,
+    so the wait can never pay off. Set the env var to 0 for the old
+    probe-once behavior (the relay watcher does this: it only invokes
+    bench.py when its own probe has already succeeded)."""
+    budget = float(os.environ.get("HIVEMALL_TPU_BENCH_TPU_ACQUIRE_S", "2400"))
+    interval = 120.0
+    deadline = time.time() + budget
+    first = True
+    while True:
+        verdict = _probe_tpu()
+        if verdict == "tpu":
+            print(f"bench: relay up at +{time.time() - deadline + budget:.0f}s"
+                  "; measuring on TPU", file=sys.stderr)
+            raw = _run_child({}, timeout=360)
+            if raw is not None and raw.get("platform") == "tpu":
+                return raw
+            print("bench: TPU measurement attempt failed; will reprobe",
+                  file=sys.stderr)
+        elif verdict == "cpu":
+            print("bench: jax came up on a host backend — no TPU relay "
+                  "configured; skipping acquisition wait", file=sys.stderr)
+            return None
+        remaining = deadline - time.time()
+        if remaining <= 0:
+            print(f"bench: TPU acquisition budget ({budget:.0f}s) exhausted; "
+                  "falling back to CPU", file=sys.stderr)
+            return None
+        if first:
+            print(f"bench: relay down; probing every {interval:.0f}s for up "
+                  f"to {budget:.0f}s", file=sys.stderr)
+            first = False
+        time.sleep(min(interval, remaining))
 
 
 def main() -> None:
-    # Probe, then TPU attempt with the env as launched, one retry (transient
-    # relay hiccups), then CPU with the relay scrubbed so backend init
-    # cannot hang. A healthy probe returns in ~15s, far below its 75s kill
-    # timeout — only a twice-dead relay skips the TPU attempts.
-    raw = None
-    if _probe_tpu() or _probe_tpu():
-        raw = _run_child({}, timeout=360)
-        if raw is None:
-            raw = _run_child({}, timeout=240)
-    else:
-        print("bench: TPU relay probe failed twice; falling back to CPU",
-              file=sys.stderr)
+    # Budget-bounded TPU acquisition first (probe every ~2 min until the
+    # relay serves or the budget runs out), then CPU with the relay scrubbed
+    # so backend init cannot hang.
+    raw = _acquire_tpu_measurement()
     if raw is None:
         from hivemall_tpu.relay_env import SCRUB_ENV
 
@@ -268,7 +322,19 @@ def main() -> None:
             "vs_baseline": round(fm / fm_anchor, 3) if fm_anchor else 0.0,
             "vs_estimated_jvm_mapper": round(
                 fm / ESTIMATED_JVM_MAPPER_ROWS_PER_SEC, 3),
-        }] + ([{
+        }] + [{
+            # sorted-window MXU update backend A/B (ops/mxu_scatter.py)
+            "metric": m,
+            "methodology": "hbm_staged_device_scan_epoch_mxu_backend",
+            "value": float(raw[k]),
+            "unit": "rows/sec",
+            "vs_baseline": round(float(raw[k]) / a, 3) if a else 0.0,
+        } for m, k, a in [
+            ("arow_train_throughput_2^22dims_32nnz",
+             "arow_mxu_rows_per_sec", arow_anchor),
+            (f"fm_train_throughput_2^22dims_k{FM_FACTORS}_32nnz",
+             "fm_mxu_rows_per_sec", fm_anchor),
+        ] if raw.get(k)] + ([{
             # the -native_scan host backend over the same staged blocks:
             # what an accelerator-less deployment runs; ~= the anchor by
             # construction (same loop), so vs_baseline ~ 1.0 is expected

@@ -131,6 +131,7 @@ def make_train_fn(
     mini_batch_average: bool = True,
     track_deltas: bool = False,
     feature_shard: Optional[Tuple[str, int]] = None,
+    update_backend: str = "xla",
 ):
     """Build the raw (unjitted) `step(state, indices, values, labels) ->
     (state, loss_sum)` — composable inside shard_map/scan by parallel/mix.py.
@@ -153,6 +154,15 @@ def make_train_fn(
     """
     if mode not in ("scan", "minibatch"):
         raise ValueError(f"unknown mode {mode!r}")
+    if update_backend not in ("xla", "mxu"):
+        raise ValueError(f"unknown update_backend {update_backend!r}")
+    if update_backend == "mxu":
+        if mode != "minibatch":
+            raise ValueError("update_backend='mxu' requires minibatch mode "
+                             "(scan mode is sequential per row)")
+        if feature_shard is not None:
+            raise ValueError("update_backend='mxu' does not compose with "
+                             "feature_shard yet; use the xla backend")
     use_cov = rule.use_covariance
 
     if feature_shard is None:
@@ -316,7 +326,118 @@ def make_train_fn(
         )
         return new_state, jnp.sum(outs.loss)
 
-    return scan_step if mode == "scan" else minibatch_step
+    def minibatch_step_mxu(state: LinearState, indices, values, labels):
+        """minibatch_step with every random table access routed through
+        ops/mxu_scatter (sorted-window one-hot matmuls) instead of XLA's
+        scalar gather/scatter engine — same FloatAccumulator semantics, f32
+        sums equal up to addition order. One packed gather serves w, cov and
+        every optimizer slot; one stacked scatter-add serves every delta
+        column plus the update counts; derive_w rules recompute w as a
+        full-table elementwise map masked by the counts (no
+        gather-after-scatter round trip at all)."""
+        from ..ops import mxu_scatter as mxu
+
+        b, k = indices.shape
+        t0 = state.step
+        ts = (t0 + 1 + jnp.arange(b)).astype(jnp.float32)
+        gl = state.globals
+        if rule.pre_batch is not None:
+            gl = rule.pre_batch(gl, labels)
+
+        d = state.weights.shape[0]
+        slot_names = tuple(sorted(state.slots))
+        plan = mxu.make_plan(indices.reshape(-1), d)
+
+        # ONE gather for everything: w [+ cov] [+ slots], padded to a
+        # power-of-two column count
+        cols = [state.weights] + ([state.covars] if use_cov else []) + \
+               [state.slots[s] for s in slot_names]
+        ncol = len(cols)
+        cpad = mxu.pad_cols(ncol)
+        packed = jnp.stack(
+            cols + [cols[0]] * (cpad - ncol), axis=-1).astype(jnp.float32)
+        g = mxu.gather(packed, plan).reshape(b, k, cpad)
+        w_g = g[..., 0]
+        pos = 1
+        cov_g = None
+        if use_cov:
+            oob = (indices < 0) | (indices >= d)
+            cov_g = jnp.where(oob, 1.0, g[..., pos])
+            pos += 1
+        sl_g = {s: g[..., pos + i] for i, s in enumerate(slot_names)}
+
+        def per_row(w, cov, sl, val, y, tf):
+            score = jnp.sum(w * val)
+            sq_norm = jnp.sum(val * val)
+            variance = jnp.sum(cov * val * val) if use_cov else jnp.zeros(())
+            ctx = RowContext(w, cov, sl, val, y, score, sq_norm, variance,
+                             tf, gl)
+            return rule.update(ctx, hyper)
+
+        outs = jax.vmap(per_row)(w_g, cov_g, sl_g, values, labels, ts)
+        upd = outs.updated.astype(jnp.float32)  # [B]
+        lane_upd = upd[:, None] * jnp.ones_like(values)  # [B, K]
+
+        # ONE stacked scatter-add into zeros: dw [+ dcov] [+ dslots] + counts
+        dcols = [outs.dw]
+        if use_cov and outs.dcov is not None:
+            dcols.append(outs.dcov)
+        scat_slots = [s for s in rule.slot_names if s in outs.dslots]
+        dcols += [outs.dslots[s] for s in scat_slots]
+        dcols.append(lane_upd)
+        nd = len(dcols)
+        dpad = mxu.pad_cols(nd)
+        dstack = jnp.stack(dcols, axis=-1).reshape(b * k, nd)
+        sums = mxu.scatter_add(
+            jnp.zeros((d, dpad), jnp.float32), indices.reshape(-1), dstack,
+            plan)
+        counts = sums[:, nd - 1]
+
+        acc = jnp.promote_types(state.weights.dtype, jnp.float32)
+        dw_sum = sums[:, 0].astype(acc)
+        denom = jnp.maximum(counts, 1.0).astype(acc) if mini_batch_average \
+            else jnp.ones((), acc)
+        weights = (state.weights.astype(acc) + dw_sum / denom) \
+            .astype(state.weights.dtype)
+        covars = state.covars
+        pos = 1
+        if use_cov and outs.dcov is not None:
+            dc_sum = sums[:, pos].astype(acc)
+            covars = (state.covars.astype(acc) + dc_sum / denom) \
+                .astype(state.covars.dtype)
+            pos += 1
+        new_slots = dict(state.slots)
+        for s in scat_slots:
+            new_slots[s] = (state.slots[s].astype(acc) +
+                            sums[:, pos].astype(acc)).astype(
+                                state.slots[s].dtype)
+            pos += 1
+
+        if rule.derive_w is not None:
+            # w is a pure elementwise function of the slots, so recompute it
+            # over the WHOLE table and keep old values where nothing fired —
+            # one fused full-table pass (~0.1ms/100MB on v5e) replaces the
+            # xla path's gather-after-scatter + set
+            tf_end = (t0 + b).astype(jnp.float32)
+            sl_full = {s: new_slots[s].astype(jnp.float32)
+                       for s in new_slots}
+            w_full = rule.derive_w(sl_full, tf_end, hyper)
+            weights = jnp.where(counts > 0,
+                                w_full.astype(state.weights.dtype), weights)
+
+        touched = jnp.maximum(state.touched, (counts > 0).astype(jnp.int8))
+        if track_deltas:
+            delta_tab = new_slots.get(DELTA_SLOT, state.slots[DELTA_SLOT])
+            new_slots[DELTA_SLOT] = delta_tab + counts.astype(delta_tab.dtype)
+
+        new_state = state.replace(
+            weights=weights, covars=covars, slots=new_slots, touched=touched,
+            step=t0 + b, globals=gl)
+        return new_state, jnp.sum(outs.loss)
+
+    if mode == "scan":
+        return scan_step
+    return minibatch_step_mxu if update_backend == "mxu" else minibatch_step
 
 
 def make_train_step(
@@ -325,9 +446,12 @@ def make_train_step(
     mode: str = "minibatch",
     mini_batch_average: bool = True,
     donate: bool = True,
+    update_backend: str = "xla",
 ):
     """Jitted wrapper over make_train_fn (the single-replica path)."""
-    fn = make_train_fn(rule, hyper, mode=mode, mini_batch_average=mini_batch_average)
+    fn = make_train_fn(rule, hyper, mode=mode,
+                       mini_batch_average=mini_batch_average,
+                       update_backend=update_backend)
     return jax.jit(fn, donate_argnums=(0,) if donate else ())
 
 

@@ -69,6 +69,10 @@ def base_options() -> Options:
           "Run exact scan epochs through the native C row loop — the "
           "host fast path for accelerator-less mappers (train_arow: any "
           "options; train_fm: -classification with a fixed -eta)")
+    o.add("mxu_scatter", None, False,
+          "Route -mini_batch table updates through the sorted-window MXU "
+          "gather/scatter (ops/mxu_scatter.py) instead of XLA's scalar "
+          "scatter engine — same semantics, f32 sums up to addition order")
     return o
 
 
@@ -255,7 +259,10 @@ def fit_linear(
         interpret = jax.devices()[0].platform != "tpu"
         step = make_pallas_scan_step(rule, hyper, interpret=interpret)
     else:
-        step = make_train_step(rule, hyper, mode=mode)
+        backend = "mxu" if (cl.has("mxu_scatter") and mode == "minibatch") \
+            else "xla"
+        step = make_train_step(rule, hyper, mode=mode,
+                               update_backend=backend)
     # SpaceEfficientDenseModel analog: above 2^24 dims the reference switches
     # to half-float storage unless -disable_halffloat
     # (ref: LearnerBaseUDTF.java:172-175); TPU-native that is bf16.

@@ -169,7 +169,8 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
                  mini_batch_average: bool = True,
                  feature_shard: Optional[Tuple[str, int]] = None,
                  pack_w: bool = True,
-                 jit: bool = True):
+                 jit: bool = True,
+                 update_backend: str = "xla"):
     """Jitted FM block update. scan = reference-exact sequential; minibatch =
     accumulate-then-apply against block-start parameters.
 
@@ -193,6 +194,31 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
     not supported sharded (its lambda updates need cross-stripe v' sums)."""
     if feature_shard is not None and hyper.adareg:
         raise ValueError("adareg is not supported with feature_shard")
+    if update_backend not in ("xla", "mxu"):
+        raise ValueError(f"unknown update_backend {update_backend!r}")
+    if update_backend == "mxu":
+        if mode != "minibatch" or feature_shard is not None:
+            raise ValueError("update_backend='mxu' requires the local "
+                             "minibatch path")
+        from ..ops.mxu_scatter import pad_cols
+
+        kp = hyper.padded_factors
+        if kp <= hyper.factors or not pack_w:
+            raise ValueError(
+                "the mxu FM path rides the packed [D, kp] table and borrows "
+                "pad lanes for w and the update counts; it needs "
+                "padded_factors > factors (k = 8/16 exactly have no pad "
+                "lane) and pack_w=True")
+        if pad_cols(kp) != kp:
+            # padded_factors rounds to a multiple of 8, not a power of two;
+            # the mxu lane protocol needs power-of-two columns — fail at
+            # build time with the constraint spelled out, not at trace time
+            raise ValueError(
+                f"the mxu FM path needs a power-of-two padded_factors "
+                f"(lane tiling, ops/mxu_scatter.py); factors="
+                f"{hyper.factors} pads to {kp} — choose k whose "
+                f"multiple-of-8 round-up is a power of two (k <= 7, "
+                f"9..15, 25..31, ...) or use the xla backend")
 
     # Borrowed-lane packing (minibatch local path): when V is lane-padded
     # (kp > k), the first pad lane carries w for the block — ONE [K,kp]
@@ -210,9 +236,11 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
                   and pack_w)
 
     if feature_shard is None:
-        def gather_and_predict(state: FMState, idx, val, packed=None):
-            if packed is not None:
-                pg = packed.at[idx].get(mode="fill", fill_value=0.0)
+        def gather_and_predict(state: FMState, idx, val, packed=None,
+                               pg=None):
+            if pg is not None or packed is not None:
+                if pg is None:
+                    pg = packed.at[idx].get(mode="fill", fill_value=0.0)
                 wg = pg[:, w_lane]
                 vg = pg.at[:, w_lane].set(0.0)  # restore the pad-lane zero
             else:
@@ -223,15 +251,16 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
     else:
         shard_axis, stripe = feature_shard
 
-        def gather_and_predict(state: FMState, idx, val, packed=None):
+        def gather_and_predict(state: FMState, idx, val, packed=None,
+                               pg=None):
             wg, vg, vmask, lidx, p, sum_vfx = sharded_gather_predict(
                 state.w, state.v, state.w0, idx, val, shard_axis, stripe)
             return wg, vg, vmask, lidx, p, sum_vfx
 
-    def row_deltas(state: FMState, idx, val, y, t, packed=None):
+    def row_deltas(state: FMState, idx, val, y, t, packed=None, pg=None):
         eta = hyper.eta.eta(t)
         wg, vg, eff_val, sidx, p, sum_vfx = gather_and_predict(
-            state, idx, val, packed)
+            state, idx, val, packed, pg)
         g, loss = _dloss_and_loss(p, y, hyper)
         dw0 = -eta * (g + 2.0 * state.lambda_w0 * state.w0)
         dw = -eta * (g * eff_val + 2.0 * state.lambda_w * wg)
@@ -282,16 +311,37 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
         state, losses = jax.lax.scan(body, state, (indices, values, labels, va_mask))
         return state, jnp.sum(losses)
 
+    use_mxu = update_backend == "mxu"
+
     def minibatch_step(state: FMState, indices, values, labels, va_mask):
         b = indices.shape[0]
         ts = (state.step + 1 + jnp.arange(b)).astype(jnp.float32)
         packed = (state.v.at[:, w_lane].set(state.w) if use_packed else None)
 
-        def per_row(idx, val, y, t):
-            return row_deltas(state, idx, val, y, t, packed)
+        plan = None
+        if use_mxu:
+            # sorted-window MXU path (ops/mxu_scatter.py): the packed
+            # [D, kp] table is gathered ONCE for the whole block and the
+            # update columns ride one windowed scatter — V traffic is the
+            # whole FM step cost on v5e (PERF.md FM bisection), and the
+            # scalar engine charges ~20ms/block for it
+            from ..ops import mxu_scatter as mxu
 
-        dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta, sidx = jax.vmap(per_row)(
-            indices, values, labels, ts)
+            plan = mxu.make_plan(indices.reshape(-1), state.w.shape[0])
+            pg_all = mxu.gather(packed, plan).reshape(indices.shape
+                                                      + (packed.shape[-1],))
+
+            def per_row(idx, val, y, t, pg):
+                return row_deltas(state, idx, val, y, t, None, pg)
+
+            dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta, sidx = \
+                jax.vmap(per_row)(indices, values, labels, ts, pg_all)
+        else:
+            def per_row(idx, val, y, t):
+                return row_deltas(state, idx, val, y, t, packed)
+
+            dw0, dw, dv, loss, g, p, sum_vfx, wg, vg, eta, sidx = \
+                jax.vmap(per_row)(indices, values, labels, ts)
         theta = (1.0 - va_mask)  # [B]
 
         def scatter_v(v_table, upd):
@@ -305,7 +355,7 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
         # store-compact/accumulate-wide policy as core/engine.py)
         acc_w = jnp.promote_types(state.w.dtype, jnp.float32)
         acc_v = jnp.promote_types(state.v.dtype, jnp.float32)
-        if mini_batch_average:
+        if mini_batch_average and not use_mxu:
             # FloatAccumulator denominators (shared by the packed and
             # unpacked apply below): per-feature touch counts, w0 by the
             # effective batch size
@@ -313,7 +363,59 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
                 jnp.broadcast_to(theta[:, None], sidx.shape), mode="drop")
             denom = jnp.maximum(counts, 1.0)
 
-        if use_packed:
+        if use_mxu:
+            # dv and dw ride one windowed scatter over the packed layout
+            # (dw on lane w_lane == factors, exactly its packed position);
+            # the per-feature update counts borrow the NEXT pad lane when
+            # the shape has one, so counts, denom and touched all come out
+            # of the same matmul pass
+            from ..ops import mxu_scatter as mxu
+
+            k_log = hyper.factors
+            kp = state.v.shape[1]
+            cnt_lane = k_log + 1 if k_log + 1 < kp else None
+            ids = indices.reshape(-1)
+            scaled = (theta[:, None, None] * jnp.concatenate(
+                [dv[..., :k_log], dw[..., None]], axis=-1)).astype(acc_v)
+            if cnt_lane is not None:
+                lane_cnt = jnp.broadcast_to(
+                    theta[:, None, None].astype(acc_v),
+                    scaled.shape[:2] + (1,))
+                scaled = jnp.concatenate([scaled, lane_cnt], axis=-1)
+            upd_flat = scaled.reshape(-1, scaled.shape[-1])
+            if mini_batch_average:
+                acc = mxu.scatter_add(jnp.zeros(state.v.shape, acc_v), ids,
+                                      upd_flat, plan)
+                if cnt_lane is None:
+                    counts = mxu.scatter_add(
+                        jnp.zeros((state.w.shape[0],), jnp.float32), ids,
+                        jnp.broadcast_to(theta[:, None],
+                                         indices.shape).reshape(-1), plan)
+                else:
+                    counts = acc[:, cnt_lane]
+                denom = jnp.maximum(counts, 1.0)
+                new_w = (state.w.astype(acc_v) + acc[:, k_log] / denom) \
+                    .astype(state.w.dtype)
+                new_v = (state.v.astype(acc_v)
+                         + acc.at[:, k_log:].set(0.0) / denom[:, None]) \
+                    .astype(state.v.dtype)
+                new_w0 = state.w0 + jnp.sum(theta * dw0) / jnp.maximum(
+                    jnp.sum(theta), 1.0)
+            else:
+                pk = mxu.scatter_add(packed, ids, upd_flat, plan)
+                new_w = pk[:, w_lane]
+                if cnt_lane is None:
+                    counts = mxu.scatter_add(
+                        jnp.zeros((state.w.shape[0],), jnp.float32), ids,
+                        jnp.broadcast_to(theta[:, None],
+                                         indices.shape).reshape(-1), plan)
+                else:
+                    counts = pk[:, cnt_lane]
+                new_v = pk.at[:, k_log:].set(0.0)
+                new_w0 = state.w0 + jnp.sum(theta * dw0)
+            touched = jnp.maximum(state.touched,
+                                  (counts > 0).astype(jnp.int8))
+        elif use_packed:
             # dw rides lane w_lane of the same flat row scatter as dv
             k_log = hyper.factors
             upd = jnp.concatenate([dv[..., :k_log], dw[..., None]], axis=-1)
@@ -356,13 +458,16 @@ def make_fm_step(hyper: FMHyper, mode: str = "minibatch",
             new_w = state.w.at[sidx].add(theta[:, None] * dw, mode="drop")
             new_v = scatter_v(state.v, theta[:, None, None] * dv)
             new_w0 = state.w0 + jnp.sum(theta * dw0)
+        if not use_mxu:
+            touched = state.touched.at[sidx].max(
+                jnp.broadcast_to((theta > 0).astype(jnp.int8)[:, None],
+                                 sidx.shape),
+                mode="drop")
         new_state = state.replace(
             w0=new_w0,
             w=new_w,
             v=new_v,
-            touched=state.touched.at[sidx].max(
-                jnp.broadcast_to((theta > 0).astype(jnp.int8)[:, None], sidx.shape),
-                mode="drop"),
+            touched=touched,
             step=state.step + b,
         )
         if hyper.adareg:
@@ -478,7 +583,9 @@ def train_fm(features: FeatureRows, targets, options: Optional[str] = None,
     if cl.has("native_scan"):
         return _train_fm_native_scan(cl, hyper, dims, idx_rows, val_rows,
                                      targets, width, block, mode, iters)
-    step = make_fm_step(hyper, mode)
+    backend = "mxu" if (cl.has("mxu_scatter") and mode == "minibatch") \
+        else "xla"
+    step = make_fm_step(hyper, mode, update_backend=backend)
     state = init_fm_state(dims, hyper)
     rng = np.random.RandomState(hyper.seed)
     conv = ConversionState(not cl.has("disable_cv"), cl.get_float("cv_rate", 0.005))

@@ -51,6 +51,10 @@ def _forest_options(gbt: bool = False) -> Options:
           "Output type (serialization/ser, opscode/vm, javascript/js) "
           "[default: opscode]", default="opscode")
     o.add("disable_compression", None, False, "accepted for parity")
+    o.add("grow", "grow_strategy", True,
+          "Forest growth strategy auto|per_tree|batched [default: auto — "
+          "per_tree unless row-sharded; measured fastest on both platforms, "
+          "scripts/bench_forest.py]", default="auto")
     if gbt:
         o.add("eta", "learning_rate", True, "Learning rate [default: 0.05]",
               default=0.05, type=float)
@@ -193,6 +197,7 @@ def train_randomforest_classifier(X, labels, options: Optional[str] = None,
         min_leaf=cl.get_int("min_samples_leaf", 1),
         max_leaf_nodes=cl.get_int("leafs", 512),
         num_vars=num_vars, rngs=tree_rngs,
+        strategy=str(cl.get("grow", "auto")),
     )
     # OOB error for all trees in one vmapped walk (ref: :330-341)
     leaf_vals = np.asarray(predict_forest_binned(stack_trees(grown), Xb))  # [T, N]
@@ -236,6 +241,7 @@ def train_randomforest_regr(X, targets, options: Optional[str] = None
         min_leaf=cl.get_int("min_samples_leaf", 1),
         max_leaf_nodes=cl.get_int("leafs", 512),
         num_vars=num_vars, rngs=tree_rngs,
+        strategy=str(cl.get("grow", "auto")),
     )
     leaf_vals = np.asarray(predict_forest_binned(stack_trees(grown), Xb))  # [T, N]
     trees: List[TreeModel] = []

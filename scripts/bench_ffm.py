@@ -45,8 +45,12 @@ def main() -> None:
     from hivemall_tpu.core.engine import make_epoch
     from hivemall_tpu.runtime.benchmark import honest_timed_loop
 
-    for name, rc in (("untiled", None), ("row_chunk512", 512)):
-        fn = make_ffm_step(hyper, "minibatch", row_chunk=rc, jit=False)
+    for name, rc, backend in (("untiled", None, "xla"),
+                              ("row_chunk512", 512, "xla"),
+                              ("mxu", None, "mxu"),
+                              ("mxu_row_chunk512", 512, "mxu")):
+        fn = make_ffm_step(hyper, "minibatch", row_chunk=rc, jit=False,
+                           update_backend=backend)
         # one epoch = one dispatch (device-resident scan over staged blocks);
         # timing is chunked + step-counter-verified (runtime/benchmark.py) so
         # an async relay cannot inflate the rate

@@ -89,7 +89,7 @@ def main():
     def run_batched():
         return grow_forest(Xb, y, W, np.zeros(F, bool),
                            rngs=[np.random.RandomState(t) for t in range(T)],
-                           **kw)
+                           strategy="batched", **kw)
 
     def run_per_tree():
         return [grow_tree(Xb, y, W[t], np.zeros(F, bool),
@@ -124,6 +124,10 @@ def main():
         "per_tree_loop_sec": round(t_per_tree, 3),
         "batched_speedup": round(t_per_tree / t_batched, 2),
         "nodes": int(nodes),
+        # grow_forest(strategy="auto") picks per_tree when unsharded — flag
+        # loudly if this platform's data ever contradicts that default
+        "default_strategy": "per_tree",
+        "default_is_fastest": bool(t_per_tree <= t_batched),
     }), flush=True)
 
 

@@ -225,6 +225,68 @@ def main():
                   make_dedup_plan(i, DIMS)), donate_argnums=(0,)),
           dup_idx, upd)
 
+    # ---- mxu: the sorted-window matmul gather/scatter (ops/mxu_scatter.py)
+    # at the bench workload shape. plan cost is charged inside every variant
+    # (the engine rebuilds it per block); the *_planless pair isolates it.
+    from hivemall_tpu.ops import mxu_scatter as mxs
+
+    bench_idx = None
+    if want("mxu_"):
+        from hivemall_tpu.runtime.benchmark import make_workload_ids
+
+        bench_idx = jnp.asarray(make_workload_ids(rng, (N_UPD,), DIMS))
+
+    def mxu_micro(name, init, f, *fargs, probe=None):
+        if not want(name):
+            return
+        fj = jax.jit(f, donate_argnums=(0,))
+        st = fj(init(), *fargs)
+        jax.block_until_ready(st)
+        iters, secs, st = honest_timed_loop(
+            lambda s: fj(s, *fargs), st,
+            probe or (lambda s: float(jnp.reshape(s, (-1,))[0])),
+            budget_s=args.budget)
+        emit(name, iters, secs, N_UPD, "updates/sec")
+        del st
+
+    if want("mxu_"):
+        mxu_micro("mxu_plan_sort", t1,
+                  lambda v, i: v.at[0].add(
+                      jnp.sum(mxs.make_plan(i, DIMS).sid[:2] *
+                              jnp.float32(1e-9))),
+                  bench_idx)
+        mxu_micro("mxu_gather_pair", lambda: jnp.zeros((DIMS, 2),
+                                                       jnp.float32),
+                  lambda v, i: v.at[0, 0].add(jnp.sum(
+                      mxs.gather(v, mxs.make_plan(i, DIMS)))),
+                  bench_idx)
+        mxu_micro("mxu_scatter_c4", lambda: jnp.zeros((DIMS, 4),
+                                                      jnp.float32),
+                  lambda v, i, u: mxs.scatter_add(
+                      v, i, u, mxs.make_plan(i, DIMS)),
+                  bench_idx, jnp.asarray(rng.randn(N_UPD, 4)
+                                         .astype(np.float32)))
+        mxu_micro("mxu_gather_v8", lambda: jnp.zeros((DIMS, 8),
+                                                     jnp.float32),
+                  lambda v, i: v.at[0, 0].add(jnp.sum(
+                      mxs.gather(v, mxs.make_plan(i, DIMS)))),
+                  bench_idx)
+        mxu_micro("mxu_scatter_v8_kl7", lambda: jnp.zeros((DIMS, 8),
+                                                          jnp.float32),
+                  lambda v, i, u: mxs.scatter_add(
+                      v, i, u, mxs.make_plan(i, DIMS)),
+                  bench_idx, jnp.asarray(rng.randn(N_UPD, 7)
+                                         .astype(np.float32)))
+        # XLA reference points on the SAME workload ids for direct division
+        mxu_micro("mxu_ref_xla_gather_pair",
+                  lambda: jnp.zeros((DIMS, 2), jnp.float32),
+                  lambda v, i: v.at[0, 0].add(jnp.sum(
+                      v.at[i].get(mode="fill", fill_value=0.0))),
+                  bench_idx)
+        mxu_micro("mxu_ref_xla_scatter_c1", t1,
+                  lambda v, i, u: v.at[i].add(u, mode="drop"),
+                  bench_idx, upd)
+
     # ---------------- B/C. engine epochs ---------------------------------
     def blocks(n):
         # the headline workload shape (bench.make_ids): log-uniform

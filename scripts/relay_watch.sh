@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Watch the axon TPU relay; whenever it serves, run whatever is left of the
 # pending hardware suite, appending one JSON line per metric to
-# PERF_TPU_r04.jsonl. Each benchmark is retried on the next uptime window
+# PERF_TPU_r05.jsonl. Each benchmark is retried on the next uptime window
 # until it has produced TPU-labeled output or the deadline passes.
 #
 # The relay drops unpredictably (see PERF.md "relay status"); this watcher
@@ -9,10 +9,10 @@
 #   setsid nohup bash scripts/relay_watch.sh >/tmp/relay_watch.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-OUT=PERF_TPU_r04.jsonl
+OUT=PERF_TPU_r05.jsonl
 # versioned so markers written by an older watcher's laxer success criteria
 # can never retire a benchmark under the current ones
-DONE_DIR=/tmp/relay_watch_done_r04
+DONE_DIR=/tmp/relay_watch_done_r05
 mkdir -p "$DONE_DIR"
 # preserve results published by any earlier watcher version that appended
 # straight to $OUT — the regeneration below would otherwise truncate them.
@@ -63,7 +63,7 @@ probe() {
     >/dev/null 2>&1
 }
 
-is_tpu_output() {  # round-4 bench.py carries platform as a JSON FIELD;
+is_tpu_output() {  # round-4+ bench.py carries platform as a JSON FIELD;
   # the per-family scripts still embed it in the metric name
   grep -qE '_tpu|"platform": *"tpu"' "$1"
 }
@@ -100,7 +100,7 @@ run_one() {  # run_one <tag> <cmd...>
 }
 
 all_done() {
-  for t in diag_micro diag_arow diag_fm diag_micro2 ctr_e2e fm ffm mc mf \
+  for t in diag_micro diag_arow diag_fm diag_micro2 diag_mxu ctr_e2e fm ffm mc mf \
            methodology pallas forest arow1 arow2; do
     [ -e "$DONE_DIR/$t" ] || return 1
   done
@@ -116,11 +116,12 @@ all_done() {
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if probe; then
     echo "[$(date +%T)] relay up" >&2
-    run_one arow1   python -u bench.py
+    run_one arow1   env HIVEMALL_TPU_BENCH_TPU_ACQUIRE_S=0 python -u bench.py
     run_one diag_micro python -u scripts/diag_scan_perf.py --budget 3 --only micro_
     run_one diag_arow  python -u scripts/diag_scan_perf.py --budget 3 --only arow
     run_one diag_fm    python -u scripts/diag_scan_perf.py --budget 3 --only fm
     run_one diag_micro2 python -u scripts/diag_scan_perf.py --budget 3 --only micro2_
+    run_one diag_mxu python -u scripts/diag_scan_perf.py --budget 3 --only mxu_
     run_one fm      python -u scripts/bench_fm.py
     run_one ffm     python -u scripts/bench_ffm.py
     run_one mc      python -u scripts/bench_mc.py
@@ -130,7 +131,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_one forest  python -u scripts/bench_forest.py
     run_one ctr_e2e python -u scripts/bench_ctr_e2e.py \
       --train-rows 2097152 --test-rows 262144 --epochs-arow 4 --epochs-fm 4
-    run_one arow2   python -u bench.py
+    run_one arow2   env HIVEMALL_TPU_BENCH_TPU_ACQUIRE_S=0 python -u bench.py
     if all_done; then
       echo "[$(date +%T)] suite complete" >&2
       exit 0

@@ -209,8 +209,12 @@ class TestForestBatchedGrowth:
                   num_vars=3)
         if classification:
             kw["n_classes"] = 2
+        # strategy="batched" explicitly: the auto default IS the per-tree
+        # loop now (grow.py round-5 strategy switch), so without it this
+        # parity test would compare the loop against itself
         forest = grow_forest(Xb, y, W, nominal, rngs=[
-            np.random.RandomState(200 + t) for t in range(T_)], **kw)
+            np.random.RandomState(200 + t) for t in range(T_)],
+            strategy="batched", **kw)
         for t in range(T_):
             solo = grow_tree(Xb, y, W[t], nominal,
                              rng=np.random.RandomState(200 + t), **kw)
@@ -241,11 +245,12 @@ class TestForestBatchedGrowth:
                   max_depth=4, min_split=2, min_leaf=1, max_leaf_nodes=32,
                   num_vars=None)
         big = grow_forest(Xb, y, W, np.zeros(4, bool),
-                          rngs=[np.random.RandomState(t) for t in range(6)], **kw)
+                          rngs=[np.random.RandomState(t) for t in range(6)],
+                          strategy="batched", **kw)
         # budget forcing G=1 (one tree per device pass) must not change output
         small = grow_forest(Xb, y, W, np.zeros(4, bool),
                             rngs=[np.random.RandomState(t) for t in range(6)],
-                            hist_budget_bytes=1, **kw)
+                            hist_budget_bytes=1, strategy="batched", **kw)
         for a, b in zip(big, small):
             np.testing.assert_array_equal(a.feature, b.feature)
             np.testing.assert_allclose(a.leaf_value, b.leaf_value)
@@ -270,7 +275,7 @@ class TestForestBatchedGrowth:
                   min_split=2, min_leaf=1, max_leaf_nodes=64, num_vars=None)
         forest = grow_forest(Xb, Y, W, np.zeros(4, bool),
                              rngs=[np.random.RandomState(t) for t in range(3)],
-                             **kw)
+                             strategy="batched", **kw)
         for t in range(3):
             solo = grow_tree(Xb, Y[t], W[t], np.zeros(4, bool),
                              rng=np.random.RandomState(t), **kw)
