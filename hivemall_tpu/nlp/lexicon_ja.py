@@ -200,7 +200,7 @@ _KATAKANA_NOUNS = [
 ]
 
 _ADVERBS = [
-    "とても", "すごく", "少し", "ちょっと", "たくさん", "もっと", "また",
+    "すごく", "少し", "ちょっと", "たくさん", "もっと", "また",
     "まだ", "すぐ", "いつも", "時々", "よく", "あまり", "全然",
     "きっと", "たぶん", "やはり", "やっぱり", "一緒に", "ゆっくり",
     "はっきり", "しっかり", "そろそろ", "だんだん", "どんどん",
@@ -216,7 +216,9 @@ _ADVERBS = [
 
 # もう gets a below-particle price: the decomposition も(助詞)+う(助動詞)
 # costs 250 on the lattice and is never the right analysis
-_CHEAP_ADVERBS = [("もう", 140)]
+_CHEAP_ADVERBS = [("もう", 140), ("とても", 140)]
+# とても joined もう here when the per-POS lattice exposed a cheaper
+# (wrong) と+て+も particle chain at the adverb's old 450 price
 
 _CONJUNCTIONS = ["そして", "しかし", "でも", "だから", "それで", "また",
                  "それから", "つまり", "例えば", "それに", "ところが",
@@ -301,6 +303,15 @@ _I_ADJ_STEMS = ["大き", "小さ", "新し", "古", "高", "安", "良", "悪",
                 "めでた", "怪し", "幼", "醜", "尊", "清"]
 
 # godan conjugation rows: final kana -> (a, i, e, o, onbin-ta-form)
+# round-5 vocabulary scale-up: extended stems feed the SAME conjugation
+# generators (lexicon_ja_ext.py holds pure vocabulary; dedup via `seen`)
+from .lexicon_ja_ext import (GODAN_EXT as _GODAN_EXT,
+                             ICHIDAN_EXT as _ICHIDAN_EXT,
+                             I_ADJ_EXT as _I_ADJ_EXT)
+
+_ICHIDAN = _ICHIDAN + _ICHIDAN_EXT
+_I_ADJ_STEMS = _I_ADJ_STEMS + _I_ADJ_EXT
+
 _GODAN_ROWS = {
     "く": ("か", "き", "け", "こ", "いた"),
     "ぐ": ("が", "ぎ", "げ", "ご", "いだ"),
@@ -312,6 +323,8 @@ _GODAN_ROWS = {
     "る": ("ら", "り", "れ", "ろ", "った"),
     "う": ("わ", "い", "え", "お", "った"),
 }
+
+_GODAN = _GODAN + [g for g in _GODAN_EXT if g[1] in _GODAN_ROWS]
 
 _COSTS = {P: 100, AUX: 150, CONJ: 300, V: 350, N: 400, ADJ: 400, ADV: 450,
           PRE: 350}
@@ -407,4 +420,59 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
         add(surface, pos, cost)
     for surface, pos, cost in _adj_forms():
         add(surface, pos, cost)
+
+    # ---- round-5 vocabulary scale-up (lexicon_ja_ext.py): pure vocabulary
+    # priced with the same scheme; the conjugation generators above already
+    # consumed the ext verb/adjective stems (see the list extensions below
+    # their definitions)
+    from . import lexicon_ja_ext as ext  # noqa: the module-level import
+    # above only pulls the stem lists; the vocabulary lists are read here
+
+    for w in (ext.NOUNS_TIME + ext.NOUNS_PEOPLE + ext.NOUNS_BODY_HEALTH +
+              ext.NOUNS_FOOD + ext.NOUNS_NATURE + ext.NOUNS_CITY_TRANSPORT +
+              ext.NOUNS_ABSTRACT + ext.NOUNS_SOCIETY + ext.NOUNS_OBJECTS +
+              ext.NOUNS_TECH + ext.NOUNS_SCHOOL_WORK +
+              ext.NOUNS_EMOTION_COMM + ext.NOUNS_ARTS_SPORTS +
+              ext.NOUNS_MISC_DAILY + ext.NOUNS_BUSINESS_LAW +
+              ext.NOUNS_MEDIA_RELIGION_MIL + ext.NOUNS_AGRI_CRAFT):
+        # +30 over the core (most-frequent) noun tier
+        add(w, N, _COSTS[N] + 30)
+    for w in ext.SURU_NOUNS:
+        add(w, N, _COSTS[N] + 10)
+    for w in ext.NA_ADJ_STEMS:
+        add(w, N, _COSTS[N] + 30)
+    for w in ext.KATAKANA_EXT:
+        add(w, N, _COSTS[N] + 100)  # same tier as the core katakana list
+    for w in (ext.SURNAMES + ext.SURNAMES2 + ext.GIVEN_NAMES +
+              ext.PLACES_JAPAN + ext.PLACES_JAPAN2 + ext.PLACES_WORLD):
+        add(w, N, _COSTS[N] + 60)  # proper nouns: rarer a priori
+    for w in ext.NUMBER_WORDS:
+        add(w, N, _COSTS[N] + 20)
+    for w in ("さん", "さま", "様", "くん", "君", "ちゃん", "氏", "殿",
+              "たち", "達"):
+        # 名詞-接尾 honorific/plural: must beat the verb-stem+auxiliary
+        # analysis of さ+ん after a name (V+AUX connection is -250, so with
+        # the +150 N,N connection these need to be VERY cheap — IPADic
+        # likewise prices 接尾 far below content words). Overwrite any
+        # dearer homograph from the core noun list
+        lex[w] = [(p, min(c, 60) if p == N else c)
+                  for p, c in lex.get(w, [])]
+        if all(p != N for p, _ in lex[w]):
+            lex[w].append((N, 60))
+    for w in ext.KANJI_SUFFIXES:
+        # Pricing (blind3/blind4 post-record fixes, PERF.md round 5; the
+        # kanji unknown model is (1100, 500) -> runs price 1600/2100/2600):
+        # a suffix must lose to the 2-kanji unknown price when its host is
+        # ALSO unknown — at 540 the tier shredded unseen compounds (減税 ->
+        # 減/税; first-pass blind3 F1 0.932). At 1400: lexicalized-host
+        # splits win (研究(400)+者(1400)+conn(150) = 1950 << the 3-kanji
+        # unknown 2600), numeral+counter splits stay under the 2-kanji
+        # unknown (二(400)+階: 1950 < 2100), while 1-kanji-UNK+suffix
+        # (1600+1400 = 3000) exceeds it, so fresh compounds stay whole
+        add(w, N, 1400)
+    for w in ext.KANJI_PREFIXES:
+        # same bound from the prefix side: 超(1400)+伝導(2100) exceeds the
+        # 3-kanji unknown 2600 (超伝導 stays whole) and prefix+suffix
+        # pairs (新+型: 1400+1400-200 = 2600) clear the 2-kanji 2100
+        add(w, "接頭詞", 1400)
     return lex

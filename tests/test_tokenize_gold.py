@@ -75,6 +75,45 @@ def test_blind2_f1_gate():
     assert m["f1"] >= 0.95, m
 
 
+@pytest.mark.parametrize("fixture,first_pass", [
+    ("tokenize_ja_blind3", 0.9320),
+    ("tokenize_ja_blind4", 0.9328),
+    ("tokenize_ja_blind5", 0.9522),
+])
+def test_round5_blind_f1_gates(fixture, first_pass):
+    """Round-5 blind ladder (VERDICT r4 next #5). Three successive fixtures
+    from OOV-dense domains (proper nouns, tech, business/law, medicine),
+    each composed blind after the then-current lexicon froze:
+
+    - blind3 first-pass 0.9320 — exposed the suffix-tier pricing bug (cheap
+      single-kanji suffixes shredding unknown compounds: 減/税) AND the
+      single-state-per-position Viterbi collapse (生ま/れ/た).
+    - blind4 first-pass 0.9328 — after those fixes; exposed the unknown-
+      model class: lexical-1-kanji + unknown-1-kanji undercutting the
+      2-kanji unknown run (雪/崩, 法/案).
+    - blind5 first-pass 0.9522 — after the kanji unknown retune
+      ((900,900) -> (1100,500)); >= 0.95, the round-5 OOV-domain accuracy
+      claim recorded in PERF.md. Each first-pass number was measured BEFORE
+      any fix responding to that fixture; folds happened only after.
+
+    Post-fold all three join the regression floor at >= 0.95."""
+    fx = load_gold(os.path.join(os.path.dirname(__file__), "data",
+                                f"{fixture}.tsv"))
+    assert len(fx) >= 30
+    pairs = [(toks, tokenize_ja(sent)) for sent, toks in fx]
+    m = segmentation_prf(pairs)
+    assert m["f1"] >= 0.95, m
+
+
+def test_lexicon_scale():
+    """Round-5 scale-up: 3043 -> ~8.9k surfaces (2.9x). Still ~2% of the
+    reference's IPADic (KuromojiUDF.java:55-86) — the honest gap — but the
+    blind ladder above measures what a user actually gets on OOV text."""
+    from hivemall_tpu.nlp.lexicon_ja import build_lexicon
+
+    assert len(build_lexicon()) >= 8500
+
+
 def test_bulk_path_scores_identically(gold):
     """The native bulk Viterbi must score exactly like the per-text path
     on the whole fixture (segmentation parity at corpus scale)."""
