@@ -277,6 +277,16 @@ def main():
                       v, i, u, mxs.make_plan(i, DIMS)),
                   bench_idx, jnp.asarray(rng.randn(N_UPD, 7)
                                          .astype(np.float32)))
+        # window-size tuning curve: MXU volume scales with W (N*W*128 MACs)
+        # while the residual risk shrinks — capture both ends in the same
+        # relay window the auto default is judged in
+        for wr in (256, 1024):
+            mxu_micro(f"mxu_gather_pair_w{wr}",
+                      lambda: jnp.zeros((DIMS, 2), jnp.float32),
+                      lambda v, i, wr=wr: v.at[0, 0].add(jnp.sum(
+                          mxs.gather(v, mxs.make_plan(i, DIMS),
+                                     window_rows=wr))),
+                      bench_idx)
         # XLA reference points on the SAME workload ids for direct division
         mxu_micro("mxu_ref_xla_gather_pair",
                   lambda: jnp.zeros((DIMS, 2), jnp.float32),
