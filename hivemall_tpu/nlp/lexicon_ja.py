@@ -306,10 +306,12 @@ _I_ADJ_STEMS = ["大き", "小さ", "新し", "古", "高", "安", "良", "悪",
 # round-5 vocabulary scale-up: extended stems feed the SAME conjugation
 # generators (lexicon_ja_ext.py holds pure vocabulary; dedup via `seen`)
 from .lexicon_ja_ext import (GODAN_EXT as _GODAN_EXT,
+                             GODAN_EXT2 as _GODAN_EXT2,
                              ICHIDAN_EXT as _ICHIDAN_EXT,
+                             ICHIDAN_EXT2 as _ICHIDAN_EXT2,
                              I_ADJ_EXT as _I_ADJ_EXT)
 
-_ICHIDAN = _ICHIDAN + _ICHIDAN_EXT
+_ICHIDAN = _ICHIDAN + _ICHIDAN_EXT + _ICHIDAN_EXT2
 _I_ADJ_STEMS = _I_ADJ_STEMS + _I_ADJ_EXT
 
 _GODAN_ROWS = {
@@ -324,7 +326,8 @@ _GODAN_ROWS = {
     "う": ("わ", "い", "え", "お", "った"),
 }
 
-_GODAN = _GODAN + [g for g in _GODAN_EXT if g[1] in _GODAN_ROWS]
+_GODAN = _GODAN + [g for g in _GODAN_EXT + _GODAN_EXT2
+                   if g[1] in _GODAN_ROWS]
 
 _COSTS = {P: 100, AUX: 150, CONJ: 300, V: 350, N: 400, ADJ: 400, ADV: 450,
           PRE: 350}
@@ -400,7 +403,8 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
         # below the katakana unknown-run price (lattice._UNK_COST) so the
         # lexical analysis wins, but near it so unseen loanwords still parse
         add(w, N, _COSTS[N] + 100)
-    for w in _ADVERBS:
+    from .lexicon_ja_ext import ADVERBS_EXT as _ADVERBS_EXT
+    for w in _ADVERBS + _ADVERBS_EXT:
         add(w, ADV, _COSTS[ADV])
     for w, cost in _CHEAP_ADVERBS:
         add(w, ADV, cost)
