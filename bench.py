@@ -149,18 +149,26 @@ def _measure() -> None:
     if platform == "tpu":
         # A/B the sorted-window MXU update backend (ops/mxu_scatter.py) in
         # the same window — the default stays whichever side this data says
-        # (r4c keep-or-revert policy)
-        fn_mxu = make_train_fn(AROW, {"r": 0.1}, mode="minibatch",
-                               update_backend="mxu")
-        out["arow_mxu_rows_per_sec"] = round(timed_epoch_loop(
-            make_epoch(fn_mxu),
-            init_linear_state(DIMS, use_covariance=True)), 1)
-        fm_fn_mxu = make_fm_step(hyper, mode="minibatch", jit=False,
-                                 update_backend="mxu")
-        fm_epoch_mxu = make_epoch(
-            lambda s, bi, bv, bl: fm_fn_mxu(s, bi, bv, bl, no_va))
-        out["fm_mxu_rows_per_sec"] = round(
-            timed_epoch_loop(fm_epoch_mxu, init_fm_state(DIMS, hyper)), 1)
+        # (r4c keep-or-revert policy). Each side is fenced: a compile/OOM
+        # failure in the EXPERIMENTAL backend must not cost the headline
+        # numbers already in `out`.
+        try:
+            fn_mxu = make_train_fn(AROW, {"r": 0.1}, mode="minibatch",
+                                   update_backend="mxu")
+            out["arow_mxu_rows_per_sec"] = round(timed_epoch_loop(
+                make_epoch(fn_mxu),
+                init_linear_state(DIMS, use_covariance=True)), 1)
+        except Exception as e:  # noqa: BLE001 - experimental side
+            print(f"bench: arow mxu A/B failed: {e!r}", file=sys.stderr)
+        try:
+            fm_fn_mxu = make_fm_step(hyper, mode="minibatch", jit=False,
+                                     update_backend="mxu")
+            fm_epoch_mxu = make_epoch(
+                lambda s, bi, bv, bl: fm_fn_mxu(s, bi, bv, bl, no_va))
+            out["fm_mxu_rows_per_sec"] = round(
+                timed_epoch_loop(fm_epoch_mxu, init_fm_state(DIMS, hyper)), 1)
+        except Exception as e:  # noqa: BLE001
+            print(f"bench: fm mxu A/B failed: {e!r}", file=sys.stderr)
     if platform == "cpu":
         # the framework's host execution backend (-native_scan): exact
         # sequential epochs through the C row loop over the same staged

@@ -45,10 +45,15 @@ def main() -> None:
     from hivemall_tpu.core.engine import make_epoch
     from hivemall_tpu.runtime.benchmark import honest_timed_loop
 
+    import traceback
+
     for name, rc, backend in (("untiled", None, "xla"),
                               ("row_chunk512", 512, "xla"),
                               ("mxu", None, "mxu"),
                               ("mxu_row_chunk512", 512, "mxu")):
+      # fenced per variant: an experimental-backend failure must not kill
+      # the run (the watcher retries non-zero exits every window)
+      try:
         fn = make_ffm_step(hyper, "minibatch", row_chunk=rc, jit=False,
                            update_backend=backend)
         # one epoch = one dispatch (device-resident scan over staged blocks);
@@ -71,6 +76,8 @@ def main() -> None:
             "ms_per_step": round(1e3 * dt / (iters * n_blocks), 3),
         }), flush=True)
         del state
+      except Exception:  # noqa: BLE001
+        traceback.print_exc()
 
 
 if __name__ == "__main__":

@@ -49,7 +49,12 @@ def main() -> None:
     # + on-device epoch replay, mirroring FactorizationMachineUDTF.java:521);
     # timing is chunked + step-counter-verified (runtime/benchmark.py) so an
     # async relay cannot inflate the rate
+    import traceback
+
     for variant, backend in (("", "xla"), ("mxu_", "mxu")):
+      # fenced per variant: an experimental-backend failure must not kill
+      # the run (the watcher retries non-zero exits every window)
+      try:
         fn = make_fm_step(hyper, mode="minibatch", jit=False,
                           update_backend=backend)
         epoch = make_epoch(lambda s, bi, bv, bl: fn(s, bi, bv, bl, va_d))
@@ -70,6 +75,8 @@ def main() -> None:
             "ms_per_step": round(1e3 * dt / (iters * n_blocks), 3),
         }), flush=True)
         del state
+      except Exception:  # noqa: BLE001
+        traceback.print_exc()
 
 
 if __name__ == "__main__":
