@@ -439,14 +439,14 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
               ext.NOUNS_EMOTION_COMM + ext.NOUNS_ARTS_SPORTS +
               ext.NOUNS_MISC_DAILY + ext.NOUNS_BUSINESS_LAW +
               ext.NOUNS_MEDIA_RELIGION_MIL + ext.NOUNS_AGRI_CRAFT +
-              ext.NOUNS_WAVE2 + ext.NOUNS_WAVE4):
+              ext.NOUNS_WAVE2 + ext.NOUNS_WAVE4 + ext.NOUNS_WAVE5):
         # +30 over the core (most-frequent) noun tier
         add(w, N, _COSTS[N] + 30)
     for w in ext.SURU_NOUNS + ext.SURU_NOUNS2:
         add(w, N, _COSTS[N] + 10)
     for w in ext.NA_ADJ_STEMS:
         add(w, N, _COSTS[N] + 30)
-    for w in ext.KATAKANA_EXT:
+    for w in ext.KATAKANA_EXT + ext.KATAKANA_EXT2:
         add(w, N, _COSTS[N] + 100)  # same tier as the core katakana list
     for w in (ext.SURNAMES + ext.SURNAMES2 + ext.GIVEN_NAMES +
               ext.PLACES_JAPAN + ext.PLACES_JAPAN2 + ext.PLACES_WORLD):
