@@ -157,6 +157,10 @@ _MISC_VERBS = [  # polite/formulaic chunks, IPADic-style single units
     "ください", "下さい", "いただき", "いただく", "くれ", "くれる",
     "もらい", "もらう", "あげる", "あり", "ある", "あっ", "なり", "なる",
     "なっ", "思い", "思っ", "言い", "言っ", "行っ", "来まし",
+    # ~ておく/~てしまう/~てみる/~てくる benefactive-aspect chains (kana
+    # verb forms IPADic lists as ordinary 動詞 entries; blind6 caught おい)
+    "おく", "おき", "おい", "おか", "しまう", "しまい", "しまっ",
+    "みる", "み", "みれ", "くる", "きまし",
 ]
 
 _INTERJECTIONS = ["ありがとう", "こんにちは", "こんばんは", "おはよう",

@@ -79,6 +79,7 @@ def test_blind2_f1_gate():
     ("tokenize_ja_blind3", 0.9320),
     ("tokenize_ja_blind4", 0.9328),
     ("tokenize_ja_blind5", 0.9522),
+    ("tokenize_ja_blind6", 0.9310),
 ])
 def test_round5_blind_f1_gates(fixture, first_pass):
     """Round-5 blind ladder (VERDICT r4 next #5). Three successive fixtures
@@ -96,7 +97,12 @@ def test_round5_blind_f1_gates(fixture, first_pass):
       claim recorded in PERF.md. Each first-pass number was measured BEFORE
       any fix responding to that fixture; folds happened only after.
 
-    Post-fold all three join the regression floor at >= 0.95."""
+    blind6 (0.9310 first-pass, composed after the wave 2-5 vocabulary
+    growth) found basic-verb inventory holes (溶かす/足す/渡る/~ておく) —
+    the honest OOV-domain band across four blind fixtures is 0.93-0.95,
+    each round's misses folded only after recording.
+
+    Post-fold all four join the regression floor at >= 0.95."""
     fx = load_gold(os.path.join(os.path.dirname(__file__), "data",
                                 f"{fixture}.tsv"))
     assert len(fx) >= 30
@@ -106,12 +112,13 @@ def test_round5_blind_f1_gates(fixture, first_pass):
 
 
 def test_lexicon_scale():
-    """Round-5 scale-up: 3043 -> ~8.9k surfaces (2.9x). Still ~2% of the
-    reference's IPADic (KuromojiUDF.java:55-86) — the honest gap — but the
-    blind ladder above measures what a user actually gets on OOV text."""
+    """Round-5 scale-up: 3043 -> ~11.5k surfaces (3.8x) over five growth
+    waves. Still ~3% of the reference's IPADic (KuromojiUDF.java:55-86) —
+    the honest gap — but the blind ladder above measures what a user
+    actually gets on OOV text."""
     from hivemall_tpu.nlp.lexicon_ja import build_lexicon
 
-    assert len(build_lexicon()) >= 8500
+    assert len(build_lexicon()) >= 11000
 
 
 def test_bulk_path_scores_identically(gold):
