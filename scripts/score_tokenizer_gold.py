@@ -19,7 +19,11 @@ def main() -> None:
     data_dir = os.path.join(os.path.dirname(__file__), "..", "tests", "data")
     for tag, fname in (("dev", "tokenize_ja_gold.tsv"),
                        ("heldout", "tokenize_ja_heldout.tsv"),
-                       ("blind2", "tokenize_ja_blind2.tsv")):
+                       ("blind2", "tokenize_ja_blind2.tsv"),
+                       ("blind3", "tokenize_ja_blind3.tsv"),
+                       ("blind4", "tokenize_ja_blind4.tsv"),
+                       ("blind5", "tokenize_ja_blind5.tsv"),
+                       ("blind6", "tokenize_ja_blind6.tsv")):
         gold = load_gold(os.path.join(data_dir, fname))
         pairs = [(toks, tokenize_ja(sent)) for sent, toks in gold]
         m = segmentation_prf(pairs)
