@@ -123,6 +123,81 @@ def test_duplicate_heavy_ids():
     np.testing.assert_array_equal(s, ref)
 
 
+def test_random_shape_sweep():
+    """Seeded sweep over (E, c, N, chunk, window) combinations — the
+    hardware A/B burns a scarce relay window, so shape-dependent bugs must
+    die here. Mix of id regimes per trial: uniform, duplicate-heavy,
+    clustered (residual-triggering), with oob sprinkled in."""
+    rng = np.random.RandomState(42)
+    for trial in range(10):
+        e = int(2 ** rng.randint(8, 15))
+        c = int(2 ** rng.randint(0, 4))
+        n = int(rng.randint(50, 5000))
+        chunk = int(2 ** rng.randint(5, 10))
+        wr = [None, 64, 256][rng.randint(3)]
+        regime = trial % 3
+        if regime == 0:
+            ids = rng.randint(0, e, size=n)
+        elif regime == 1:
+            ids = (rng.zipf(1.5, size=n) % e)
+        else:  # clustered
+            ids = np.concatenate([
+                rng.randint(0, max(2, e // 64), size=n // 2),
+                rng.randint(max(1, e - 64), e, size=n - n // 2)])
+        ids = ids.astype(np.int32)
+        ids[:: 13] = e + 1  # oob
+        ref_ids = _mask_ref_ids(ids, e)
+        table = rng.randn(e, c).astype(np.float32)
+        upd = rng.randn(n, c).astype(np.float32)
+        t = jnp.asarray(table)
+        plan = mx.make_plan(jnp.asarray(ids), e, chunk=chunk)
+        g = np.asarray(mx.gather(t, plan, window_rows=wr))
+        ref_g = np.asarray(t.at[ref_ids].get(mode="fill", fill_value=0.0))
+        np.testing.assert_array_equal(
+            g, ref_g, err_msg=f"trial {trial} E={e} c={c} n={n} "
+                              f"chunk={chunk} wr={wr}")
+        s = np.asarray(mx.scatter_add(t, jnp.asarray(ids),
+                                      jnp.asarray(upd), plan,
+                                      window_rows=wr))
+        ref_s = np.asarray(t.at[ref_ids].add(jnp.asarray(upd),
+                                             mode="drop"))
+        np.testing.assert_allclose(
+            s, ref_s, atol=2e-4,
+            err_msg=f"trial {trial} E={e} c={c} n={n}")
+
+
+def test_ffm_backend_production_shape():
+    """FFM mxu at a realistic (if shrunken) shape — hashed pair keys over a
+    2^16 table, 24 lanes/row, 256-row block — the closest CPU-feasible
+    stand-in for the bench shape the relay window will hit."""
+    from hivemall_tpu.models.ffm import (FFMHyper, init_ffm_state,
+                                         make_ffm_step)
+
+    rng = np.random.RandomState(3)
+    hyper = FFMHyper(factors=4, classification=True, num_features=1 << 14,
+                     v_dims=1 << 16, num_fields=32)
+    b, k = 256, 24
+    idx = rng.randint(0, hyper.num_features, size=(b, k)).astype(np.int32)
+    val = np.ones((b, k), np.float32)
+    fld = rng.randint(0, 32, size=(b, k)).astype(np.int32)
+    lab = np.sign(rng.randn(b)).astype(np.float32)
+    v0 = rng.randn(hyper.v_dims, hyper.factors).astype(np.float32) * 0.05
+
+    def mk():  # the jitted step donates its input state — fresh per call
+        return init_ffm_state(hyper).replace(v=jnp.asarray(v0))
+
+    args = (jnp.asarray(idx), jnp.asarray(val), jnp.asarray(fld),
+            jnp.asarray(lab))
+    sx, lx = make_ffm_step(hyper, "minibatch")(mk(), *args)
+    sm, lm = make_ffm_step(hyper, "minibatch", update_backend="mxu")(
+        mk(), *args)
+    assert np.allclose(float(lx), float(lm), rtol=1e-5)
+    for f in ("w", "v", "v_gg", "z", "n"):
+        np.testing.assert_allclose(np.asarray(getattr(sx, f)),
+                                   np.asarray(getattr(sm, f)), atol=1e-5,
+                                   err_msg=f)
+
+
 def test_engine_minibatch_backend_parity():
     """xla vs mxu minibatch steps across rule shapes: covariance (AROW),
     plain (PA1), covariance+hyper (SCW1), slots+derive_w (AdaGradRDA) —
