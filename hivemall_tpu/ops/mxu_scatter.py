@@ -50,10 +50,20 @@ LANES = 128
 # The MXU's fast path multiplies in bf16; under the default precision XLA
 # would round the gathered/scattered f32 TABLE values to 8 mantissa bits on
 # TPU (CPU ignores precision — the parity suite would never see it).
-# HIGHEST keeps every one-hot product exact in f32; the one-hot operand is
-# already exactly representable, so a 3-pass manual split is the measured
-# follow-up if the 6-pass cost shows up on hardware.
+# HIGHEST keeps every one-hot product exact in f32 (the default here).
+# HIGH (TPU: 3-pass bf16) halves the MXU passes at <= 1-ulp f32 error —
+# the one-hot operand is exact in bf16, so only the table side splits; the
+# diag mxu_ group A/Bs both so the hardware window prices the trade.
 PRECISION = jax.lax.Precision.HIGHEST
+
+
+def _resolve_precision(precision):
+    if precision is None:
+        return PRECISION
+    if isinstance(precision, str):
+        return {"high": jax.lax.Precision.HIGH,
+                "highest": jax.lax.Precision.HIGHEST}[precision]
+    return precision
 
 
 class WindowPlan(NamedTuple):
@@ -159,7 +169,8 @@ def _chunk_meta(plan: WindowPlan, ipr: int, rows: int, w: int):
 
 
 def gather(table: jnp.ndarray, plan: WindowPlan,
-           window_rows: int | None = None) -> jnp.ndarray:
+           window_rows: int | None = None,
+           precision=None) -> jnp.ndarray:
     """`table.at[ids].get(mode="fill", fill_value=0.0)` over the plan's ids,
     returned in ORIGINAL id order. `table` is [E] or [E, c] (c a power of two
     <= 128); result is [N] or [N, c] f32."""
@@ -168,6 +179,7 @@ def gather(table: jnp.ndarray, plan: WindowPlan,
     e, c = t2.shape
     if e != plan.n_entries:
         raise ValueError(f"plan built for E={plan.n_entries}, table has {e}")
+    prec = _resolve_precision(precision)
     ipr, rows = _table_geometry(e, c, 128)
     w = window_rows or _auto_window(plan, rows)
     ipr, rows = _table_geometry(e, c, w)
@@ -182,11 +194,11 @@ def gather(table: jnp.ndarray, plan: WindowPlan,
         win = jax.lax.dynamic_slice(tiles, (start, 0), (w, LANES))
         oh_row = ((rel_c[:, None] == iota_w[None, :]) & inw_c[:, None]) \
             .astype(jnp.float32)                                  # [C, W]
-        picked = jnp.matmul(oh_row, win, precision=PRECISION)     # [C, 128]
+        picked = jnp.matmul(oh_row, win, precision=prec)     # [C, 128]
         oh_g = (grp_c[:, None] == iota_g[None, :]).astype(jnp.float32)
         vals = jnp.einsum("cg,cgk->ck", oh_g,
                           picked.reshape(cch, ipr, c),
-                          precision=PRECISION)                    # [C, c]
+                          precision=prec)                    # [C, c]
         return None, vals
 
     _, vals = jax.lax.scan(body, None, (starts, rel, group, in_win))
@@ -212,7 +224,8 @@ def gather(table: jnp.ndarray, plan: WindowPlan,
 
 def scatter_add(table: jnp.ndarray, ids_flat: jnp.ndarray,
                 upd: jnp.ndarray, plan: WindowPlan,
-                window_rows: int | None = None) -> jnp.ndarray:
+                window_rows: int | None = None,
+                precision=None) -> jnp.ndarray:
     """`table.at[ids].add(upd, mode="drop")` with the update columns carried
     through one id-keyed sort and accumulated window-by-window on the MXU.
     `table` [E] or [E, c]; `upd` [N] or [N, kl] with kl <= c (original id
@@ -226,6 +239,7 @@ def scatter_add(table: jnp.ndarray, ids_flat: jnp.ndarray,
     e, c = t2.shape
     if e != plan.n_entries:
         raise ValueError(f"plan built for E={plan.n_entries}, table has {e}")
+    prec = _resolve_precision(precision)
     ipr, rows = _table_geometry(e, c, 128)
     w = window_rows or _auto_window(plan, rows)
     ipr, rows = _table_geometry(e, c, w)
@@ -259,8 +273,8 @@ def scatter_add(table: jnp.ndarray, ids_flat: jnp.ndarray,
             .astype(jnp.float32)                                  # [C, W]
         oh_g = (grp_c[:, None] == iota_g[None, :]).astype(jnp.float32)
         spread = jnp.einsum("cg,ck->cgk", oh_g, u_c,
-                            precision=PRECISION).reshape(cch, LANES)
-        win = win + jnp.matmul(oh_row.T, spread, precision=PRECISION)
+                            precision=prec).reshape(cch, LANES)
+        win = win + jnp.matmul(oh_row.T, spread, precision=prec)
         return jax.lax.dynamic_update_slice(tiles, win, (start, 0)), None
 
     tiles, _ = jax.lax.scan(body, tiles,

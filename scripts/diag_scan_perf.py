@@ -287,6 +287,20 @@ def main():
                           mxs.gather(v, mxs.make_plan(i, DIMS),
                                      window_rows=wr))),
                       bench_idx)
+        # precision curve: HIGH = 3-pass bf16 (<= 1-ulp f32), HIGHEST
+        # (the default) = 6-pass exact — prices the exactness premium
+        mxu_micro("mxu_gather_pair_prec_high",
+                  lambda: jnp.zeros((DIMS, 2), jnp.float32),
+                  lambda v, i: v.at[0, 0].add(jnp.sum(
+                      mxs.gather(v, mxs.make_plan(i, DIMS),
+                                 precision="high"))),
+                  bench_idx)
+        mxu_micro("mxu_scatter_c4_prec_high",
+                  lambda: jnp.zeros((DIMS, 4), jnp.float32),
+                  lambda v, i, u: mxs.scatter_add(
+                      v, i, u, mxs.make_plan(i, DIMS), precision="high"),
+                  bench_idx, jnp.asarray(rng.randn(N_UPD, 4)
+                                         .astype(np.float32)))
         # XLA reference points on the SAME workload ids for direct division
         mxu_micro("mxu_ref_xla_gather_pair",
                   lambda: jnp.zeros((DIMS, 2), jnp.float32),
