@@ -1,0 +1,101 @@
+"""Summarize the r05 TPU captures into the mxu keep-or-revert verdict.
+
+Reads PERF_TPU_r05.jsonl (the relay watcher's per-tag publication) and
+prints, per family, the xla-vs-mxu comparison plus the component micros —
+the one-command analysis for the moment a relay window lands captures.
+
+Run: python scripts/analyze_mxu_ab.py [path]
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else "PERF_TPU_r05.jsonl"
+    rows = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    d = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "metric" in d:
+                    rows[d["metric"]] = d
+    except FileNotFoundError:
+        print(f"{path} not found — no TPU captures yet")
+        return
+
+    def v(metric):
+        d = rows.get(metric)
+        return d.get("value") if d else None
+
+    def find(substr):
+        return {m: d for m, d in rows.items() if substr in m}
+
+    print(f"== {path}: {len(rows)} distinct metrics ==\n")
+
+    # headline bench.py A/B rides extra_metrics of the stable line
+    head = [d for m, d in rows.items()
+            if m == "arow_train_throughput_2^22dims_32nnz"
+            and d.get("platform") == "tpu"]
+    verdicts = []
+    for d in head:
+        xla = d.get("value")
+        extras = {e.get("methodology", e["metric"]): e
+                  for e in d.get("extra_metrics", [])}
+        for em, e in extras.items():
+            if "mxu" in str(em):
+                print(f"bench.py AROW: xla {xla:,.0f} rows/s vs mxu "
+                      f"{e['value']:,.0f} -> "
+                      f"{'MXU WINS' if e['value'] > xla else 'xla wins'} "
+                      f"({e['value']/xla:.2f}x)")
+                verdicts.append(("arow", e["value"] / xla))
+        fm_pairs = [e for e in d.get("extra_metrics", [])
+                    if e["metric"].startswith("fm_train")]
+        fm_xla = [e for e in fm_pairs if "mxu" not in
+                  str(e.get("methodology", ""))]
+        fm_mxu = [e for e in fm_pairs if "mxu" in
+                  str(e.get("methodology", ""))]
+        if fm_xla and fm_mxu:
+            a, b = fm_xla[0]["value"], fm_mxu[0]["value"]
+            print(f"bench.py FM:   xla {a:,.0f} rows/s vs mxu {b:,.0f} -> "
+                  f"{'MXU WINS' if b > a else 'xla wins'} ({b/a:.2f}x)")
+            verdicts.append(("fm", b / a))
+
+    # family benches
+    for fam, pat_xla, pat_mxu in (
+            ("bench_fm", "fm_train_throughput_2^22dims_k5_32nnz_device_scan_tpu",
+             "fm_train_throughput_2^22dims_k5_32nnz_mxu_device_scan_tpu"),
+            ("bench_ffm untiled", "ffm_train_throughput_k4_32nnz_64fields_untiled_device_scan_tpu",
+             "ffm_train_throughput_k4_32nnz_64fields_mxu_device_scan_tpu"),
+            ("bench_ffm chunked", "ffm_train_throughput_k4_32nnz_64fields_row_chunk512_device_scan_tpu",
+             "ffm_train_throughput_k4_32nnz_64fields_mxu_row_chunk512_device_scan_tpu")):
+        a, b = v(pat_xla), v(pat_mxu)
+        if a and b:
+            print(f"{fam}: xla {a:,.0f} vs mxu {b:,.0f} -> "
+                  f"{'MXU WINS' if b > a else 'xla wins'} ({b/a:.2f}x)")
+            verdicts.append((fam, b / a))
+
+    micros = find("diag_mxu")
+    if micros:
+        print("\ncomponent micros (updates/sec):")
+        for m in sorted(micros):
+            print(f"  {m}: {micros[m]['value']:,.0f} "
+                  f"({micros[m].get('ms_per_iter', '?')} ms/iter)")
+
+    if verdicts:
+        wins = [f for f, r in verdicts if r > 1.0]
+        print(f"\nVERDICT: mxu wins on {len(wins)}/{len(verdicts)} "
+              f"families: {wins}")
+        print("If a family wins: flip its default "
+              "(engine/fm/ffm update_backend + trainer option docs) and "
+              "record the A/B in PERF.md. If it loses: keep xla and record "
+              "the honest negative (r4c policy).")
+    else:
+        print("\nNo TPU A/B pairs captured yet.")
+
+
+if __name__ == "__main__":
+    main()
