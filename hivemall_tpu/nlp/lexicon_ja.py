@@ -316,7 +316,9 @@ from .lexicon_ja_ext import (GODAN_EXT as _GODAN_EXT,
                              I_ADJ_EXT as _I_ADJ_EXT)
 
 _ICHIDAN = _ICHIDAN + _ICHIDAN_EXT + _ICHIDAN_EXT2
-_I_ADJ_STEMS = _I_ADJ_STEMS + _I_ADJ_EXT
+from .lexicon_ja_ext import I_ADJ_EXT2 as _I_ADJ_EXT2
+
+_I_ADJ_STEMS = _I_ADJ_STEMS + _I_ADJ_EXT + _I_ADJ_EXT2
 
 _GODAN_ROWS = {
     "く": ("か", "き", "け", "こ", "いた"),
@@ -422,7 +424,8 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
         add(w, "接頭詞", 320)
     for w in _MISC_VERBS:
         add(w, V, _COSTS[V])
-    for w in _INTERJECTIONS:
+    from .lexicon_ja_ext import INTERJECTIONS_EXT as _INTERJ_EXT
+    for w in _INTERJECTIONS + _INTERJ_EXT:
         add(w, "感動詞", 300)
     for surface, pos, cost in _verb_forms():
         add(surface, pos, cost)
@@ -450,7 +453,7 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
         add(w, N, _COSTS[N] + 30)
     for w in ext.SURU_NOUNS + ext.SURU_NOUNS2:
         add(w, N, _COSTS[N] + 10)
-    for w in ext.NA_ADJ_STEMS:
+    for w in ext.NA_ADJ_STEMS + ext.NA_ADJ_STEMS2:
         add(w, N, _COSTS[N] + 30)
     for w in ext.KATAKANA_EXT + ext.KATAKANA_EXT2:
         add(w, N, _COSTS[N] + 100)  # same tier as the core katakana list
