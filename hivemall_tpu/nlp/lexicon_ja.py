@@ -311,11 +311,13 @@ _I_ADJ_STEMS = ["大き", "小さ", "新し", "古", "高", "安", "良", "悪",
 # generators (lexicon_ja_ext.py holds pure vocabulary; dedup via `seen`)
 from .lexicon_ja_ext import (GODAN_EXT as _GODAN_EXT,
                              GODAN_EXT2 as _GODAN_EXT2,
+                             GODAN_EXT3 as _GODAN_EXT3,
                              ICHIDAN_EXT as _ICHIDAN_EXT,
                              ICHIDAN_EXT2 as _ICHIDAN_EXT2,
+                             ICHIDAN_EXT3 as _ICHIDAN_EXT3,
                              I_ADJ_EXT as _I_ADJ_EXT)
 
-_ICHIDAN = _ICHIDAN + _ICHIDAN_EXT + _ICHIDAN_EXT2
+_ICHIDAN = _ICHIDAN + _ICHIDAN_EXT + _ICHIDAN_EXT2 + _ICHIDAN_EXT3
 from .lexicon_ja_ext import I_ADJ_EXT2 as _I_ADJ_EXT2
 
 _I_ADJ_STEMS = _I_ADJ_STEMS + _I_ADJ_EXT + _I_ADJ_EXT2
@@ -332,7 +334,7 @@ _GODAN_ROWS = {
     "う": ("わ", "い", "え", "お", "った"),
 }
 
-_GODAN = _GODAN + [g for g in _GODAN_EXT + _GODAN_EXT2
+_GODAN = _GODAN + [g for g in _GODAN_EXT + _GODAN_EXT2 + _GODAN_EXT3
                    if g[1] in _GODAN_ROWS]
 
 _COSTS = {P: 100, AUX: 150, CONJ: 300, V: 350, N: 400, ADJ: 400, ADV: 450,
