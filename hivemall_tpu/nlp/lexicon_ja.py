@@ -452,7 +452,8 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
               ext.NOUNS_WAVE6 + ext.NOUNS_WAVE7 + ext.NOUNS_WAVE8 +
               ext.NOUNS_WAVE9 + ext.NOUNS_WAVE10 + ext.NOUNS_WAVE13 +
               ext.NOUNS_WAVE14 + ext.NOUNS_WAVE15 + ext.NOUNS_WAVE16 +
-              ext.NOUNS_WAVE17 + ext.NOUNS_WAVE18 + ext.NOUNS_WAVE19):
+              ext.NOUNS_WAVE17 + ext.NOUNS_WAVE18 + ext.NOUNS_WAVE19 +
+              ext.NOUNS_WAVE20):
         # +30 over the core (most-frequent) noun tier
         add(w, N, _COSTS[N] + 30)
     for w in ext.SURU_NOUNS + ext.SURU_NOUNS2:
