@@ -453,10 +453,10 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
               ext.NOUNS_WAVE9 + ext.NOUNS_WAVE10 + ext.NOUNS_WAVE13 +
               ext.NOUNS_WAVE14 + ext.NOUNS_WAVE15 + ext.NOUNS_WAVE16 +
               ext.NOUNS_WAVE17 + ext.NOUNS_WAVE18 + ext.NOUNS_WAVE19 +
-              ext.NOUNS_WAVE20 + ext.NOUNS_WAVE21):
+              ext.NOUNS_WAVE20 + ext.NOUNS_WAVE21 + ext.YOJI_IDIOMS):
         # +30 over the core (most-frequent) noun tier
         add(w, N, _COSTS[N] + 30)
-    for w in ext.SURU_NOUNS + ext.SURU_NOUNS2:
+    for w in ext.SURU_NOUNS + ext.SURU_NOUNS2 + ext.SURU_NOUNS3:
         add(w, N, _COSTS[N] + 10)
     for w in ext.NA_ADJ_STEMS + ext.NA_ADJ_STEMS2:
         add(w, N, _COSTS[N] + 30)
