@@ -463,7 +463,7 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
         add(w, N, _COSTS[N] + 10)
     for w in ext.NA_ADJ_STEMS + ext.NA_ADJ_STEMS2:
         add(w, N, _COSTS[N] + 30)
-    for w in ext.KATAKANA_EXT + ext.KATAKANA_EXT2:
+    for w in ext.KATAKANA_EXT + ext.KATAKANA_EXT2 + ext.KATAKANA_EXT3:
         add(w, N, _COSTS[N] + 100)  # same tier as the core katakana list
     for w in (ext.SURNAMES + ext.SURNAMES2 + ext.GIVEN_NAMES +
               ext.PLACES_JAPAN + ext.PLACES_JAPAN2 + ext.PLACES_WORLD):
