@@ -458,7 +458,7 @@ def build_lexicon() -> Dict[str, List[Tuple[str, int]]]:
               ext.NOUNS_WAVE26 + ext.NOUNS_WAVE27 + ext.NOUNS_WAVE28 +
               ext.NOUNS_WAVE29 + ext.NOUNS_WAVE31 + ext.NOUNS_WAVE32 +
               ext.NOUNS_WAVE33 + ext.NOUNS_WAVE34 + ext.NOUNS_WAVE35 +
-              ext.NOUNS_WAVE36 + ext.NOUNS_WAVE37):
+              ext.NOUNS_WAVE36 + ext.NOUNS_WAVE37 + ext.NOUNS_WAVE38):
         # +30 over the core (most-frequent) noun tier
         add(w, N, _COSTS[N] + 30)
     for w in ext.SURU_NOUNS + ext.SURU_NOUNS2 + ext.SURU_NOUNS3:
