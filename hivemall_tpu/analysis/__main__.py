@@ -1,0 +1,101 @@
+"""CLI: ``python -m hivemall_tpu.analysis [paths] [options]``.
+
+Exit codes: 0 = clean against the baseline; 1 = new findings; 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from .baseline import (DEFAULT_BASELINE, diff_against_baseline,
+                       load_baseline, write_baseline)
+from .findings import Finding, Severity
+from .runner import analyze_paths, iter_python_files, normalize_path
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hivemall_tpu.analysis",
+        description="graftcheck: JAX/TPU-aware static analysis "
+                    "(recompile / host-sync / dtype / axis / donation / "
+                    "side-effect hazards)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: hivemall_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline json (default: packaged baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="accept the current findings as the new baseline")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (e.g. G001,G002)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from .rules import RULE_DOCS
+        for rule_id in sorted(RULE_DOCS):
+            print(f"{rule_id}  {RULE_DOCS[rule_id]}")
+        return 0
+
+    paths = args.paths or ["hivemall_tpu"]
+    rules = [r.strip().upper() for r in args.rules.split(",")] \
+        if args.rules else None
+    findings = analyze_paths(paths, rules=rules)
+
+    if args.update_baseline:
+        # a partial scan refreshes only the scanned files' entries; accepted
+        # debt in unscanned (still-existing) files is carried over so
+        # `lint.sh <file> --update-baseline`-style runs can't clobber it
+        scanned = {normalize_path(p) for p in iter_python_files(paths)}
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(DEFAULT_BASELINE))))
+        carried = [b for b in load_baseline(args.baseline)
+                   if b.path not in scanned
+                   and os.path.exists(os.path.join(repo_root,
+                                                   *b.path.split("/")))]
+        merged = sorted(carried + list(findings),
+                        key=lambda f: (f.path, f.line, f.rule, f.message))
+        out = write_baseline(merged, args.baseline)
+        print(f"graftcheck: baseline updated: {out} ({len(findings)} "
+              f"scanned + {len(carried)} carried finding(s))")
+        return 0
+
+    if args.no_baseline:
+        new, stale = list(findings), []
+    else:
+        scanned = [normalize_path(p) for p in iter_python_files(paths)]
+        new, stale = diff_against_baseline(findings, load_baseline(
+            args.baseline), scanned_paths=scanned)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "stale": [f.to_dict() for f in stale],
+            "total": len(findings),
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        for b in stale:
+            print(f"note: stale baseline entry ({b.rule} {b.path}: "
+                  f"{b.snippet!r}) — refresh with --update-baseline")
+        n_err = sum(1 for f in new if f.severity == Severity.ERROR)
+        n_warn = len(new) - n_err
+        if new:
+            print(f"graftcheck: {n_err} error(s), {n_warn} warning(s) not "
+                  f"in baseline ({len(findings)} total findings)")
+        else:
+            print(f"graftcheck: clean ({len(findings)} baselined finding(s)"
+                  f", {len(stale)} stale)" if (findings or stale)
+                  else "graftcheck: clean")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

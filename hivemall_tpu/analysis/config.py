@@ -1,0 +1,88 @@
+"""Codebase-specific knobs for the graftcheck rules.
+
+graftcheck is purpose-built for this repo's JAX idioms: the hot-path module
+list, the mesh axis registry, and the jitted-factory naming convention live
+here rather than being rediscovered per rule.
+"""
+
+from __future__ import annotations
+
+import re
+
+# --- G002: modules whose loops are per-step hot paths -----------------------
+# The per-step loops of these modules drive every benchmark; an implicit
+# device->host sync there serializes dispatch (BENCH_r01-r05 regressions).
+HOT_LOOP_MODULES = (
+    "hivemall_tpu/core/engine.py",
+    "hivemall_tpu/parallel/sharded_train.py",
+    "hivemall_tpu/parallel/mix.py",
+    "hivemall_tpu/models/trees/grow.py",
+    # the epoch/convergence driver that loops the engine's jitted steps
+    "hivemall_tpu/models/base.py",
+)
+
+# Methods with these names receive device state / blocks by contract, so
+# their parameters are treated as device values even outside a loop.
+HOT_FN_RE = re.compile(r"^(step|_step|train_step|epoch)$")
+
+# Calls that force an implicit device->host transfer when applied to a
+# device value. jax.device_get is handled separately (it is the explicit,
+# batched boundary idiom — flagged only when used per-element).
+SYNC_CALLS = ("float", "int", "bool")
+SYNC_NP_CALLS = ("asarray", "array")
+SYNC_METHODS = ("item", "tolist")
+
+# --- taint: factories returning jitted callables ----------------------------
+# `step = make_train_step(...)` / `predict = make_predict(...)`: calling the
+# result yields device arrays. Matched against the callee name.
+JITTED_FACTORY_RE = re.compile(
+    r"^make_\w*(step|epoch|predict|train_fn|mix|fn)\w*$")
+
+# Attribute callees whose results are device values (trainer convention).
+JITTED_ATTR_CALLEES = ("_step", "step")
+
+# Transforms whose function argument is traced when called.
+TRACING_TRANSFORMS = (
+    "jit", "vmap", "pmap", "shard_map", "scan", "cond", "while_loop",
+    "fori_loop", "checkpoint", "remat", "grad", "value_and_grad", "custom_vjp",
+)
+
+# Calls whose RESULT is host data even when arguments are device values.
+UNTAINT_CALLS = ("device_get", "shape", "len", "range", "eval_shape",
+                 "tree_structure")
+
+# --- G003: dtype-sensitive scopes ------------------------------------------
+# Modules whose math feeds weight updates: bare literals / float64 here can
+# silently upcast the bf16-above-2^24 storage policy (models/base.py).
+DTYPE_MODULE_PREFIXES = (
+    "hivemall_tpu/ops/",
+    "hivemall_tpu/core/",
+    "hivemall_tpu/models/",
+    "hivemall_tpu/kernels/",
+)
+# Update-math modules where even host-side helper functions are checked for
+# unpinned float literals (their outputs flow straight into rule updates).
+DTYPE_MATH_MODULES = (
+    "hivemall_tpu/ops/eta.py",
+    "hivemall_tpu/ops/losses.py",
+)
+
+# --- G004: mesh axis registry ----------------------------------------------
+# Fallback when parallel/mesh.py is outside the scanned path set. When it IS
+# scanned, its module-level string constants and Mesh(...) literals extend
+# this set.
+MESH_FILE = "hivemall_tpu/parallel/mesh.py"
+DEFAULT_AXIS_NAMES = frozenset({"workers", "shards"})
+COLLECTIVE_CALLS = ("psum", "pmean", "pmax", "pmin", "all_gather",
+                    "axis_index", "ppermute", "psum_scatter", "pcast")
+
+# --- G005: donation --------------------------------------------------------
+# jit-wrapped functions whose name looks step-shaped should donate their
+# model-state argument; otherwise every hot-loop step copies the tables.
+STEP_NAME_RE = re.compile(r"(step|epoch|train)", re.IGNORECASE)
+
+# --- G006: host side effects -----------------------------------------------
+SIDE_EFFECT_CALLS = ("print",)
+SIDE_EFFECT_ATTR_ROOTS = ("time", "logging")
+SIDE_EFFECT_METHODS = ("increment", "set_gauge", "record")
+SIDE_EFFECT_NP_RANDOM = ("random",)

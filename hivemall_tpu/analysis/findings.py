@@ -1,0 +1,94 @@
+"""Finding record + inline-suppression parsing.
+
+A finding's identity for baseline purposes is ``(rule, path, snippet)`` —
+the stripped source line, not the line number — so unrelated edits above a
+known finding don't invalidate the baseline, while any edit to the flagged
+line itself surfaces it again.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Set
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+
+    ORDER = {ERROR: 0, WARNING: 1}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based
+    rule: str  # "G001".."G006" ("G000" = parse failure)
+    severity: str  # Severity.*
+    message: str
+    snippet: str  # stripped source of the flagged line (baseline key)
+
+    @property
+    def key(self):
+        return (self.rule, self.path, self.snippet)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "severity": self.severity,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        return cls(path=d["path"], line=int(d.get("line", 0)),
+                   rule=d["rule"], severity=d.get("severity", Severity.ERROR),
+                   message=d.get("message", ""), snippet=d.get("snippet", ""))
+
+
+_DISABLE_RE = re.compile(
+    r"#\s*graftcheck:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\s]+)")
+
+
+def parse_suppressions(source: str):
+    """Return (per_line, whole_file): per_line maps 1-based line number to the
+    set of rule ids disabled on that line; whole_file is the set disabled for
+    the entire module (``# graftcheck: disable-file=G00X`` anywhere).
+    ``all`` disables every rule."""
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip().upper() for r in m.group("rules").split(",")
+                 if r.strip()}
+        if m.group("file"):
+            whole_file |= rules
+        else:
+            per_line[i] = per_line.get(i, set()) | rules
+    return per_line, whole_file
+
+
+def apply_suppressions(findings: List[Finding], per_line, whole_file
+                       ) -> List[Finding]:
+    out = []
+    for f in findings:
+        disabled = whole_file | per_line.get(f.line, set())
+        if "ALL" in disabled or f.rule in disabled:
+            continue
+        out.append(f)
+    return out
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))

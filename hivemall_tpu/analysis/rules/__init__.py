@@ -1,0 +1,21 @@
+"""Rule registry: each rule module exports RULE_ID and check(model)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ..findings import Finding
+from ..modmodel import ModuleModel
+from . import (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
+               g005_donation, g006_side_effect)
+
+_MODULES = (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
+            g005_donation, g006_side_effect)
+
+ALL_RULES: Dict[str, Callable[[ModuleModel], List[Finding]]] = {
+    m.RULE_ID: m.check for m in _MODULES
+}
+
+RULE_DOCS: Dict[str, str] = {
+    m.RULE_ID: (m.__doc__ or "").strip().splitlines()[0] for m in _MODULES
+}

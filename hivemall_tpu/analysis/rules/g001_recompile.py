@@ -1,0 +1,134 @@
+"""G001 recompile-hazard: code shapes that force XLA retracing.
+
+Four patterns:
+
+(a) Python ``if``/``while`` whose test reads a *traced* value inside a
+    traced function — every distinct concrete value retraces (or raises
+    ConcretizationError). ``is (not) None`` / ``isinstance`` / containment
+    tests are pruned: pytree *structure* is static at trace time.
+(b) ``jax.jit(...)`` constructed inside a ``for``/``while`` body — a fresh
+    jit wrapper per iteration never hits its own cache (the
+    production-metric class of the ads-infra paper: recompilation count).
+(c) f-strings / dict-or-format keys derived from ``.shape`` or traced
+    values inside traced functions — shape-keyed Python caches silently
+    fork one compilation per shape.
+(d) non-literal ``static_argnums``/``static_argnames`` — data-dependent
+    static args hash per value and retrace per batch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..findings import Finding, Severity
+from ..modmodel import ModuleModel, dotted_name, enclosing_loop, walk_scope
+
+RULE_ID = "G001"
+
+
+def _prune_static_tests(test: ast.expr) -> List[ast.expr]:
+    """Drop subtrees whose truth is static at trace time, return the rest."""
+    if isinstance(test, ast.BoolOp):
+        out: List[ast.expr] = []
+        for v in test.values:
+            out.extend(_prune_static_tests(v))
+        return out
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _prune_static_tests(test.operand)
+    if isinstance(test, ast.Compare):
+        # x is None / x is not None — structure checks, static under trace
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return []
+        # k in outs.dslots — dict/tuple membership is Python-level structure
+        if all(isinstance(op, (ast.In, ast.NotIn)) for op in test.ops):
+            return []
+    if isinstance(test, ast.Call):
+        callee = dotted_name(test.func)
+        if callee in ("isinstance", "hasattr", "len", "callable"):
+            return []
+    return [test]
+
+
+def _names_in(expr: ast.expr):
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            yield node.id
+
+
+def _has_shape_access(expr: ast.expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in ("shape", "dtype",
+                                                             "ndim"):
+            return True
+    return False
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, msg: str, sev: str = Severity.ERROR) -> None:
+        findings.append(Finding(model.rel_path, node.lineno, RULE_ID, sev,
+                                msg, model.snippet(node.lineno)))
+
+    # (a) + (c): per traced function
+    for fn in model.functions:
+        if not model.is_traced(fn):
+            continue
+        tainted, callables = model.taint_function(fn, taint_params=True)
+        for node in walk_scope(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                for sub in _prune_static_tests(node.test):
+                    hot = sorted(n for n in _names_in(sub) if n in tainted)
+                    if hot:
+                        kind = "while" if isinstance(node, ast.While) else "if"
+                        emit(node, f"Python `{kind}` on traced value(s) "
+                                   f"{', '.join(hot)} inside jitted "
+                                   f"`{fn.name}` — use jnp.where/lax.cond or "
+                                   f"hoist to a static arg")
+                        break
+            elif isinstance(node, ast.JoinedStr):
+                for fv in node.values:
+                    if not isinstance(fv, ast.FormattedValue):
+                        continue
+                    if _has_shape_access(fv.value) or any(
+                            n in tainted for n in _names_in(fv.value)):
+                        emit(node, f"f-string over traced/shape value inside "
+                                   f"jitted `{fn.name}` — shape-keyed strings "
+                                   f"fork one compile per shape",
+                             Severity.WARNING)
+                        break
+
+    # (b): jax.jit under a loop (within one function scope — a jit inside a
+    # def that is merely *defined* in a loop runs once per call, not per
+    # iteration, so the ancestor walk stops at function boundaries)
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in (
+                "jax.jit", "jit") and enclosing_loop(node) is not None:
+            emit(node, "jax.jit(...) constructed inside a loop — a fresh "
+                       "wrapper per iteration never hits its own compile "
+                       "cache; hoist the jit out of the loop")
+
+    # (d): non-literal static_argnums/static_argnames
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        is_jit = callee in ("jax.jit", "jit")
+        is_partial_jit = callee in ("partial", "functools.partial") and \
+            node.args and dotted_name(node.args[0]) in ("jax.jit", "jit")
+        if not (is_jit or is_partial_jit):
+            continue
+        for kw in node.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            v = kw.value
+            ok = isinstance(v, ast.Constant) or (
+                isinstance(v, (ast.Tuple, ast.List))
+                and all(isinstance(e, ast.Constant) for e in v.elts))
+            if not ok:
+                emit(kw.value, f"non-literal {kw.arg} — data-dependent "
+                               f"static args retrace per distinct value; "
+                               f"use a literal tuple")
+
+    return findings

@@ -1,0 +1,105 @@
+"""G002 host-sync-in-hot-loop: implicit device->host reads on the hot path.
+
+Scope: the per-step modules in ``config.HOT_LOOP_MODULES`` (core/engine.py,
+parallel/sharded_train.py, parallel/mix.py, models/trees/grow.py, plus the
+epoch driver models/base.py). Inside those modules the rule flags, on
+device values:
+
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` / ``np.asarray(x)`` /
+  ``np.array(x)`` / ``x.item()`` / ``x.tolist()`` inside any host-side
+  ``for``/``while`` loop — each one blocks dispatch until the device
+  catches up, serializing the step stream (the per-step host overhead the
+  terascale-learning paper eliminates);
+- the same calls anywhere in a method named ``step``/``_step``/``epoch``
+  (those receive device state by contract, loop or not);
+- ``jax.device_get(x[i])`` / ``jax.device_get(state.field)`` in a loop —
+  per-element transfers. One whole-value/tuple ``jax.device_get`` per loop
+  body is the *approved* batched boundary read (move convergence/metrics
+  reads to epoch or level boundaries and fetch everything in one transfer).
+
+Device values are identified by the module model's taint walker; host
+functions only taint jnp/jax results and jitted-callable results, so
+already-fetched host state (``jax.device_get(...)`` results, numpy arrays)
+never false-positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import config
+from ..findings import Finding, Severity
+from ..modmodel import (ModuleModel, dotted_name, enclosing_loop, walk_scope)
+
+RULE_ID = "G002"
+
+
+def _is_hot_module(model: ModuleModel) -> bool:
+    """Hot-path modules from config, plus any module that opts in with a
+    `# graftcheck: hot-module` marker (used by fixtures and future hot
+    paths outside the canonical four)."""
+    return (model.rel_path in config.HOT_LOOP_MODULES
+            or "# graftcheck: hot-module" in model.source)
+
+
+def _sync_call_kind(call: ast.Call):
+    """(kind, arg) when `call` is a sync-inducing read, else None."""
+    callee = dotted_name(call.func)
+    if callee in config.SYNC_CALLS and len(call.args) >= 1:
+        return callee, call.args[0]
+    if callee is not None and "." in callee:
+        root, tail = callee.split(".", 1)
+        if root in ("np", "numpy") and tail in config.SYNC_NP_CALLS \
+                and len(call.args) >= 1:
+            return callee, call.args[0]
+    if isinstance(call.func, ast.Attribute) \
+            and call.func.attr in config.SYNC_METHODS and not call.args:
+        return f".{call.func.attr}()", call.func.value
+    return None
+
+
+def _is_device_get(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    return name is not None and name.rsplit(".", 1)[-1] == "device_get"
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    if not _is_hot_module(model):
+        return []
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, msg: str) -> None:
+        findings.append(Finding(model.rel_path, node.lineno, RULE_ID,
+                                Severity.ERROR, msg,
+                                model.snippet(node.lineno)))
+
+    for fn in model.functions:
+        if model.is_traced(fn):
+            continue  # traced code cannot host-sync; G006 covers its effects
+        hot_fn = bool(config.HOT_FN_RE.match(fn.name))
+        tainted, callables = model.taint_function(fn, taint_params=hot_fn)
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            in_loop = enclosing_loop(node) is not None
+            if not in_loop and not hot_fn:
+                continue
+            sync = _sync_call_kind(node)
+            if sync is not None:
+                kind, arg = sync
+                if model.expr_tainted(arg, tainted, callables):
+                    where = "hot loop" if in_loop else f"`{fn.name}()`"
+                    emit(node, f"`{kind}` on a device value inside {where} "
+                               f"— blocks dispatch per step; batch the read "
+                               f"to an epoch boundary with one "
+                               f"jax.device_get")
+                continue
+            if in_loop and _is_device_get(node) and node.args:
+                arg = node.args[0]
+                if isinstance(arg, (ast.Subscript, ast.Attribute)) \
+                        and model.expr_tainted(arg, tainted, callables):
+                    emit(node, "per-element jax.device_get in a hot loop — "
+                               "fetch the whole batch/tuple in ONE "
+                               "device_get at the loop boundary")
+    return findings

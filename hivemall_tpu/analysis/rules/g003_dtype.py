@@ -1,0 +1,104 @@
+"""G003 dtype-drift: float64 and unpinned literals in update math.
+
+The storage policy (models/base.py, LearnerBaseUDTF.java:172-175 analog)
+stores tables bf16 above 2^24 dims; rule math deliberately runs f32 and
+casts once at the table write. Two drift channels break that silently:
+
+(a) ``np.float64`` / ``np.double`` / ``dtype=float`` / ``astype(float)``
+    anywhere in the dtype-sensitive packages (ops/, core/, models/,
+    kernels/) — f64 propagates through every downstream op and doubles
+    both HBM and VPU cost (error);
+(b) bare Python float literals as arithmetic operands inside traced
+    functions and inside the update-math modules (ops/eta.py,
+    ops/losses.py) — under ``jax_enable_x64`` (or numpy-scalar mixing) a
+    bare literal promotes the whole expression; pin with
+    ``jnp.asarray(lit, x.dtype)`` so the expression follows the array's
+    dtype (warning).
+
+Literals passed as *call arguments* (``jnp.maximum(x, 1.0)``) follow JAX
+weak-type promotion against an explicit array and are not flagged;
+comparison thresholds (``p > -100.0``) are likewise safe.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import config
+from ..findings import Finding, Severity
+from ..modmodel import ModuleModel, dotted_name, walk_scope
+
+RULE_ID = "G003"
+
+_F64_NAMES = ("np.float64", "numpy.float64", "np.double", "numpy.double",
+              "np.float_", "numpy.float_", "jnp.float64")
+
+
+def _in_dtype_modules(model: ModuleModel) -> bool:
+    return (model.rel_path.startswith(config.DTYPE_MODULE_PREFIXES)
+            or "# graftcheck: dtype-module" in model.source)
+
+
+def _is_math_module(model: ModuleModel) -> bool:
+    return (model.rel_path in config.DTYPE_MATH_MODULES
+            or "# graftcheck: dtype-module" in model.source)
+
+
+def _float_literal_operands(binop: ast.BinOp):
+    for side in (binop.left, binop.right):
+        node = side
+        if isinstance(node, ast.UnaryOp):
+            node = node.operand
+        if isinstance(node, ast.Constant) and isinstance(node.value, float):
+            yield side
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    if not _in_dtype_modules(model):
+        return []
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, msg: str, sev: str) -> None:
+        findings.append(Finding(model.rel_path, node.lineno, RULE_ID, sev,
+                                msg, model.snippet(node.lineno)))
+
+    # (a) float64 anywhere in dtype-sensitive modules
+    for node in ast.walk(model.tree):
+        name = dotted_name(node) if isinstance(node, (ast.Attribute,
+                                                      ast.Name)) else None
+        if name in _F64_NAMES:
+            # only flag *loads* (np.float64(x), dtype=np.float64), not the
+            # attribute inside a larger dotted chain
+            parent = getattr(node, "graftcheck_parent", None)
+            if isinstance(parent, ast.Attribute):
+                continue
+            emit(node, f"{name} in update math — f64 doubles HBM traffic "
+                       f"and silently upcasts the bf16 storage policy "
+                       f"(models/base.py); use float32/bfloat16",
+                 Severity.ERROR)
+        elif isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" and node.args:
+                a = node.args[0]
+                if isinstance(a, ast.Name) and a.id == "float":
+                    emit(node, "astype(float) is float64 — pin an explicit "
+                               "32-bit (or table) dtype", Severity.ERROR)
+
+    # (b) unpinned float literals in arithmetic
+    for fn in model.functions:
+        scan = model.is_traced(fn) or _is_math_module(model)
+        if not scan:
+            continue
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.BinOp):
+                continue
+            if not isinstance(node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div,
+                                        ast.Pow, ast.Mod, ast.FloorDiv)):
+                continue
+            for lit in _float_literal_operands(node):
+                emit(lit, f"bare float literal {ast.unparse(lit)} in update "
+                          f"arithmetic — pin with jnp.asarray(lit, x.dtype) "
+                          f"so x64/np-scalar mixing cannot promote the "
+                          f"update dtype", Severity.WARNING)
+    return findings

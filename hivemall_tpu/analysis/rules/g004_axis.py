@@ -1,0 +1,112 @@
+"""G004 axis-name-mismatch: collective axis literals vs the declared mesh.
+
+``jax.lax.psum(x, "worker")`` against a mesh whose axis is ``"workers"``
+fails only at run time, inside shard_map, on hardware. The mesh axis
+registry is small and static — ``parallel/mesh.py`` declares WORKER_AXIS /
+SHARD_AXIS and every trainer threads those through — so any *string
+literal* axis name that is not a declared axis is a typo.
+
+Declared axes = config.DEFAULT_AXIS_NAMES, plus (when mesh.py is in the
+scanned set or importable) its module-level string constants, plus literal
+axis tuples passed to ``Mesh(...)`` / ``make_mesh*(axis_name=...)`` in the
+module under scan (modules may define private meshes). Variable axis names
+are trusted — they trace back to the registry by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Set
+
+from .. import config
+from ..findings import Finding, Severity
+from ..modmodel import ModuleModel, dotted_name
+
+RULE_ID = "G004"
+
+_AXIS_KWARGS = ("axis_name", "axis_names", "replica_axis", "shard_axis")
+
+
+def _mesh_file_axes() -> Set[str]:
+    """Module-level string constants of parallel/mesh.py, parsed (not
+    imported — graftcheck must not pull in jax)."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    mesh_py = os.path.join(os.path.dirname(here), "parallel", "mesh.py")
+    axes: Set[str] = set()
+    try:
+        with open(mesh_py, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return axes
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and any(isinstance(t, ast.Name) and t.id.endswith("_AXIS")
+                        for t in node.targets):
+            axes.add(node.value.value)
+    return axes
+
+
+def _declared_axes(model: ModuleModel) -> Set[str]:
+    axes = set(config.DEFAULT_AXIS_NAMES) | _mesh_file_axes()
+    for node in ast.walk(model.tree):
+        # local string constants named *_AXIS count as declarations
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Constant) \
+                and isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.endswith("_AXIS"):
+                    axes.add(node.value.value)
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        tail = callee.rsplit(".", 1)[-1]
+        # only mesh CONSTRUCTORS declare axes; axis kwargs on collectives
+        # are uses and must validate against the declarations
+        if tail != "Mesh" and not tail.startswith("make_mesh"):
+            continue
+        if tail == "Mesh" and len(node.args) >= 2:
+            names = node.args[1]
+            if isinstance(names, (ast.Tuple, ast.List)):
+                for elt in names.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str):
+                        axes.add(elt.value)
+        for kw in node.keywords:
+            if kw.arg in _AXIS_KWARGS and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                axes.add(kw.value.value)
+    return axes
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    axes = _declared_axes(model)
+    findings: List[Finding] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        tail = callee.rsplit(".", 1)[-1]
+        if tail not in config.COLLECTIVE_CALLS:
+            continue
+        # axis name: second positional (psum(x, axis)) or axis_name= kwarg;
+        # axis_index takes it first.
+        cand = None
+        if tail == "axis_index":
+            cand = node.args[0] if node.args else None
+        elif len(node.args) >= 2:
+            cand = node.args[1]
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                cand = kw.value
+        if isinstance(cand, ast.Constant) and isinstance(cand.value, str) \
+                and cand.value not in axes:
+            findings.append(Finding(
+                model.rel_path, node.lineno, RULE_ID, Severity.ERROR,
+                f"collective `{tail}` over axis '{cand.value}' which is not "
+                f"a declared mesh axis ({', '.join(sorted(axes))}) — typo'd "
+                f"axis names fail only at run time inside shard_map",
+                model.snippet(node.lineno)))
+    return findings

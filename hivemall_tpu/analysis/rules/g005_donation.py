@@ -1,0 +1,119 @@
+"""G005 donation-misuse: hot-loop jits that copy state, or reuse donated.
+
+(a) A jit wrapper around a step-shaped function (name matches
+    step/epoch/train) without ``donate_argnums`` forces XLA to keep the
+    input model tables alive across the step — at 2^24-dim tables that is
+    a full extra HBM copy per step (warning; predict-shaped wrappers are
+    exempt: their inputs are reused by design).
+(b) Reading a variable after passing it at a donated position of a known
+    donating jit (``name = jax.jit(fn, donate_argnums=(0,))``) — the
+    buffer was handed to XLA; the read sees a deleted array at run time,
+    but only on paths that actually execute (error).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import config
+from ..findings import Finding, Severity
+from ..modmodel import ModuleModel, dotted_name, walk_scope
+
+RULE_ID = "G005"
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, msg: str, sev: str) -> None:
+        findings.append(Finding(model.rel_path, node.lineno, RULE_ID, sev,
+                                msg, model.snippet(node.lineno)))
+
+    # (a) step-shaped jit wrappers without donate_argnums
+    for wrap, wrapped_name in model.jit_wraps:
+        if wrap.has_donate:
+            continue
+        name = wrapped_name or ""
+        tail = name.rsplit(".", 1)[-1]
+        if config.STEP_NAME_RE.search(tail):
+            emit(wrap.call,
+                 f"jax.jit({tail}) without donate_argnums — a hot-loop step "
+                 f"keeps an extra copy of the model tables alive in HBM; "
+                 f"donate the state argument", Severity.WARNING)
+
+    # (b) read-after-donate, linear scan per function body
+    donating = {name: wrap for name, wrap in model.jit_aliases.items()
+                if wrap.donate_argnums}
+    if not donating:
+        return findings
+    for fn in model.functions:
+        if model.is_traced(fn):
+            continue
+        stmts = list(fn.body)
+        _scan_block(model, fn, stmts, donating, emit)
+    return findings
+
+
+def _assigned_names(stmt: ast.stmt):
+    """Every name (re)bound anywhere within `stmt`, including inside
+    compound-statement bodies — a rebind on any path clears the donation."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                yield from _target_names(tgt)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            yield from _target_names(node.target)
+        elif isinstance(node, ast.For):
+            yield from _target_names(node.target)
+
+
+def _target_names(tgt):
+    if isinstance(tgt, ast.Name):
+        yield tgt.id
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _target_names(elt)
+    elif isinstance(tgt, ast.Starred):
+        yield from _target_names(tgt.value)
+
+
+def _donated_name(call: ast.Call, donating) -> Optional[str]:
+    callee = dotted_name(call.func)
+    wrap = donating.get(callee) if callee else None
+    if wrap is None:
+        return None
+    for pos in wrap.donate_argnums or ():
+        if pos < len(call.args) and isinstance(call.args[pos], ast.Name):
+            return call.args[pos].id
+    return None
+
+
+def _scan_block(model, fn, stmts, donating, emit) -> None:
+    """Flag reads of a donated Name after the donating call, stopping at
+    reassignment. Straight-line approximation: nested blocks are scanned
+    in statement order."""
+    pending = {}  # var name -> lineno of donation
+    for stmt in stmts:
+        # reads in this statement of still-pending donated names
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                    and node.id in pending:
+                emit(node, f"`{node.id}` read after being donated to a "
+                           f"jitted step at line {pending[node.id]} — the "
+                           f"buffer belongs to XLA now; rebind the result "
+                           f"(`{node.id} = step({node.id}, ...)`) or drop "
+                           f"donation", Severity.ERROR)
+                del pending[node.id]
+        # reassignment clears the pending flag
+        for name in _assigned_names(stmt):
+            pending.pop(name, None)
+        # new donations introduced by this statement
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                victim = _donated_name(node, donating)
+                if victim is not None:
+                    # `state = step(state, ...)` rebinds: not pending
+                    if victim in set(_assigned_names(stmt)):
+                        continue
+                    pending[victim] = node.lineno

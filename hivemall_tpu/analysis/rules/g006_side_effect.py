@@ -1,0 +1,122 @@
+"""G006 untraced-side-effect: host effects baked into traced functions.
+
+A jitted function's Python body runs ONCE, at trace time. ``print``,
+metrics-counter increments, ``time.*`` reads, ``np.random`` draws, and
+mutation of free (closure) Python state inside a traced function execute
+once per *compile*, not once per *step* — the counter silently stops
+counting, the print lies, the mutation races the trace cache. Use
+``jax.debug.print`` / ``jax.debug.callback`` for real per-step effects, or
+hoist the effect to the host loop.
+
+Flagged inside traced functions:
+- calls to ``print`` / ``time.*`` / ``logging.*`` / ``np.random.*`` /
+  known metrics methods (``.increment()`` / ``.set_gauge()`` /
+  ``.record()``);
+- assignment to subscripts/attributes of free variables and
+  ``.append``/``.update``/``.add`` on free variables (closure mutation);
+- ``global`` / ``nonlocal`` declarations.
+
+``jax.debug.*`` is the sanctioned escape hatch and is never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .. import config
+from ..findings import Finding, Severity
+from ..modmodel import ModuleModel, dotted_name, walk_scope
+
+RULE_ID = "G006"
+
+_MUTATING_METHODS = ("append", "update", "add", "extend", "insert", "pop",
+                     "setdefault", "write")
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, (ast.For,)) and isinstance(node.target,
+                                                         ast.Name):
+            names.add(node.target.id)
+        elif isinstance(node, ast.comprehension):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    names.add(n.id)
+    return names
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, msg: str, sev: str = Severity.ERROR) -> None:
+        findings.append(Finding(model.rel_path, node.lineno, RULE_ID, sev,
+                                msg, model.snippet(node.lineno)))
+
+    for fn in model.functions:
+        if not model.is_traced(fn):
+            continue
+        locals_ = _local_names(fn)
+        for node in walk_scope(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                emit(node, f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}` "
+                           f"mutation inside jitted `{fn.name}` runs once "
+                           f"per compile, not per step")
+            elif isinstance(node, ast.Call):
+                callee = dotted_name(node.func) or ""
+                root = callee.split(".", 1)[0]
+                tail = callee.rsplit(".", 1)[-1]
+                if callee.startswith("jax.debug."):
+                    continue  # the sanctioned per-step effect
+                if callee in config.SIDE_EFFECT_CALLS:
+                    emit(node, f"`{callee}` inside jitted `{fn.name}` fires "
+                               f"at trace time only — use jax.debug.print "
+                               f"for per-step output")
+                elif root in config.SIDE_EFFECT_ATTR_ROOTS:
+                    emit(node, f"`{callee}` inside jitted `{fn.name}` reads "
+                               f"host state at trace time only")
+                elif callee.startswith(("np.random.", "numpy.random.")):
+                    emit(node, f"`{callee}` inside jitted `{fn.name}` draws "
+                               f"ONCE at trace time — every step replays the "
+                               f"same numbers; thread a jax.random key")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in config.SIDE_EFFECT_METHODS:
+                    emit(node, f"metrics call `.{node.func.attr}()` inside "
+                               f"jitted `{fn.name}` counts compiles, not "
+                               f"steps — increment in the host loop")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATING_METHODS \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id not in locals_ \
+                        and isinstance(getattr(node, "graftcheck_parent",
+                                               None), ast.Expr):
+                    emit(node, f"mutation of free variable "
+                               f"`{node.func.value.id}.{node.func.attr}(...)`"
+                               f" inside jitted `{fn.name}` happens at trace "
+                               f"time only")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    base = tgt
+                    while isinstance(base, (ast.Subscript, ast.Attribute)):
+                        base = base.value
+                    if isinstance(base, ast.Name) and base.id not in locals_ \
+                            and not isinstance(tgt, ast.Name):
+                        emit(tgt, f"write into free variable `{base.id}` "
+                                  f"inside jitted `{fn.name}` mutates host "
+                                  f"state at trace time only")
+    return findings

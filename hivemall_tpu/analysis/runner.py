@@ -1,0 +1,84 @@
+"""File walking + rule dispatch + diagnostics formatting.
+
+Stdlib-only and jax-free by design: a full-tree scan must stay well under
+the 5s budget of scripts/lint.sh, and graftcheck must be runnable on hosts
+without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence
+
+from .findings import (Finding, Severity, apply_suppressions,
+                       parse_suppressions, sort_findings)
+from .modmodel import ModuleModel
+
+_SKIP_DIRS = {"__pycache__", ".git", ".mypy_cache", ".pytest_cache"}
+
+
+def normalize_path(path: str) -> str:
+    """Stable repo-relative path: anchored at the `hivemall_tpu` package
+    when the file lives inside it (so baselines don't depend on the
+    checkout location), else relative to cwd, else absolute."""
+    ap = os.path.abspath(path)
+    parts = Path(ap).parts
+    if "hivemall_tpu" in parts:
+        idx = len(parts) - 1 - tuple(reversed(parts)).index("hivemall_tpu")
+        return "/".join(parts[idx:])
+    rp = os.path.relpath(ap)
+    if not rp.startswith(".."):
+        return rp.replace(os.sep, "/")
+    return ap.replace(os.sep, "/")
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+        elif p.endswith(".py"):
+            yield p
+
+
+def analyze_source(source: str, rel_path: str,
+                   rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run graftcheck over one module's source. `rel_path` is the
+    normalized path used for scope decisions (hot modules, dtype modules)
+    and reporting."""
+    from .rules import ALL_RULES
+
+    try:
+        model = ModuleModel(rel_path, source, ast.parse(source,
+                                                        filename=rel_path))
+    except SyntaxError as e:
+        return [Finding(rel_path, e.lineno or 0, "G000", Severity.ERROR,
+                        f"syntax error: {e.msg}", "")]
+    findings: List[Finding] = []
+    for rule_id, check in ALL_RULES.items():
+        if rules is not None and rule_id not in rules:
+            continue
+        findings.extend(check(model))
+    per_line, whole_file = parse_suppressions(source)
+    return sort_findings(apply_suppressions(findings, per_line, whole_file))
+
+
+def analyze_paths(paths: Sequence[str],
+                  rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            findings.append(Finding(normalize_path(path), 0, "G000",
+                                    Severity.ERROR, f"unreadable: {e}", ""))
+            continue
+        findings.extend(analyze_source(source, normalize_path(path),
+                                       rules=rules))
+    return sort_findings(findings)

@@ -22,6 +22,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Sequence, Tuple, Union
 
+import jax
 import numpy as np
 
 from ..constants import DEFAULT_NUM_FEATURES
@@ -105,17 +106,20 @@ class TrainedLinearModel:
         width = pad_to_bucket(max((len(r) for r in idx_rows), default=1))
         want_var = return_variance and self.rule.use_covariance
         predict = make_predict(use_covariance=want_var)
+        # keep per-block outputs on device so dispatch stays async across
+        # blocks; ONE batched transfer at the end (graftcheck G002)
         scores, variances = [], []
         for block in iter_blocks(idx_rows, val_rows, np.zeros(n), self.dims, 4096, width):
             out = predict(self.state, block.indices, block.values)
             if want_var:
-                scores.append(np.asarray(out[0]))
-                variances.append(np.asarray(out[1]))
+                scores.append(out[0])
+                variances.append(out[1])
             else:
-                scores.append(np.asarray(out))
+                scores.append(out)
         if want_var:
+            scores, variances = jax.device_get((scores, variances))
             return np.concatenate(scores)[:n], np.concatenate(variances)[:n]
-        return np.concatenate(scores)[:n]
+        return np.concatenate(jax.device_get(scores))[:n]
 
     def model_rows(self, filter_zero: bool = False):
         return model_rows(self.state, filter_zero)
@@ -252,8 +256,6 @@ def fit_linear(
                                 labels, width, block_size,
                                 initial_weights, initial_covars)
     if cl.has("pallas") and mode == "scan":
-        import jax
-
         from ..kernels.linear_scan import make_pallas_scan_step
 
         interpret = jax.devices()[0].platform != "tpu"
@@ -293,12 +295,17 @@ def fit_linear(
             idx_rows, val_rows, labels = shuffle_rows(
                 idx_rows, val_rows, labels, cl.get_int("seed", 31) + it
             )
-        epoch_loss = 0.0
+        # losses stay on device through the epoch — a float() per block
+        # would sync the dispatch stream every step; the convergence check
+        # only needs the epoch total, fetched in ONE batched device_get at
+        # the epoch boundary (graftcheck G002)
+        epoch_losses = []
         for block in iter_blocks(idx_rows, val_rows, labels, dims, block_size, width):
             state, loss = step(state, block.indices, block.values, block.labels)
-            epoch_loss += float(loss)
+            epoch_losses.append(loss)
             row_counter.increment(block.batch_size)
         iter_counter.increment()
+        epoch_loss = float(np.sum(jax.device_get(epoch_losses)))
         conv.incr_loss(epoch_loss)
         if iters > 1 and conv.is_converged(n):
             break

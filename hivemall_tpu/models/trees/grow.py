@@ -360,6 +360,9 @@ def grow_tree(
                 feat_ok[s, rng.choice(F, size=num_vars, replace=False)] = True
         feat_okj = jnp.asarray(feat_ok)
 
+        # ONE batched device_get per level for the split decision arrays —
+        # element-wise np.asarray reads here would sync the dispatch stream
+        # once per array instead of once per level (graftcheck G002)
         if classification:
             if row_shard is not None:
                 hist = _sharded_hist_fn("cls", mesh_, axis_, S_pad, n_bins,
@@ -367,12 +370,8 @@ def grow_tree(
             else:
                 hist = _hist_classification(Xb, yj, wj, assign, S_pad,
                                             n_bins, n_classes)
-            gain, bf, bb, counts = _best_split_classification(
-                hist, nomj, feat_okj, rule, float(min_leaf))
-            gain = np.asarray(gain)
-            bf = np.asarray(bf)
-            bb = np.asarray(bb)
-            counts = np.asarray(counts)
+            gain, bf, bb, counts = jax.device_get(_best_split_classification(
+                hist, nomj, feat_okj, rule, float(min_leaf)))
             node_sizes = counts.sum(-1)
         else:
             if row_shard is not None:
@@ -380,13 +379,9 @@ def grow_tree(
                                          n_bins, 0)(Xb, yj, wj, assign)
             else:
                 stats = _hist_regression(Xb, yj, wj, S_pad, n_bins, assign)
-            gain, bf, bb, cnts, means = _best_split_regression(
-                stats, nomj, feat_okj, float(min_leaf))
-            gain = np.asarray(gain)
-            bf = np.asarray(bf)
-            bb = np.asarray(bb)
-            node_sizes = np.asarray(cnts)
-            means = np.asarray(means)
+            gain, bf, bb, node_sizes, means = jax.device_get(
+                _best_split_regression(stats, nomj, feat_okj,
+                                       float(min_leaf)))
 
         # decide splits on host (tiny); build next frontier (padded slots stay
         # leaves so _update_assign keeps power-of-two shapes too)
@@ -637,6 +632,8 @@ def grow_forest(
                                 b.rng.choice(F, size=num_vars, replace=False)] = True
             feat_okj = jnp.asarray(feat_ok)
 
+            # ONE batched device_get per level-chunk (graftcheck G002), as
+            # in grow_tree
             if classification:
                 if row_shard is not None:
                     hist = _sharded_hist_fn(
@@ -645,12 +642,9 @@ def grow_forest(
                 else:
                     hist = _hist_classification_forest(
                         Xbj, yj, W_c, a_c, S_pad, n_bins, n_classes)
-                gain, bf, bb, counts = _best_split_classification(
-                    hist, nomj, feat_okj, rule, float(min_leaf))
-                gain = np.asarray(gain)
-                bf = np.asarray(bf)
-                bb = np.asarray(bb)
-                counts = np.asarray(counts)
+                gain, bf, bb, counts = jax.device_get(
+                    _best_split_classification(hist, nomj, feat_okj, rule,
+                                               float(min_leaf)))
                 node_sizes = counts.sum(-1)
             else:
                 if per_tree_y:
@@ -664,13 +658,9 @@ def grow_forest(
                 else:
                     stats = _hist_regression_forest(Xbj, y_c, W_c, a_c,
                                                     S_pad, n_bins)
-                gain, bf, bb, cnts, means = _best_split_regression(
-                    stats, nomj, feat_okj, float(min_leaf))
-                gain = np.asarray(gain)
-                bf = np.asarray(bf)
-                bb = np.asarray(bb)
-                node_sizes = np.asarray(cnts)
-                means = np.asarray(means)
+                gain, bf, bb, node_sizes, means = jax.device_get(
+                    _best_split_regression(stats, nomj, feat_okj,
+                                           float(min_leaf)))
 
             # host split decisions per tree (same policy as grow_tree)
             isleaf = np.ones((G, S_pad), bool)

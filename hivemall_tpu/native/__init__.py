@@ -17,13 +17,37 @@ _LIB_PATH = os.path.join(os.path.dirname(__file__), "libhivemall_native.so")
 _lib: Optional[ctypes.CDLL] = None
 
 
+_load_error: Optional[str] = None
+
+
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib
+    global _lib, _load_error
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    if _load_error is not None or not os.path.exists(_LIB_PATH):
         return None
-    lib = ctypes.CDLL(_LIB_PATH)
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+        _bind_core(lib)
+    except (OSError, AttributeError) as e:
+        # a built .so that cannot load on THIS host (toolchain/libstdc++
+        # mismatch — OSError) or that predates a core symbol
+        # (AttributeError from the prototype binding) is the same situation
+        # as an unbuilt one: fall back to the Python implementations
+        # (identical semantics), once, loudly
+        _load_error = str(e)
+        import warnings
+
+        warnings.warn(f"hivemall_tpu.native: {_LIB_PATH} failed to load "
+                      f"({e}); using Python fallbacks — rebuild with "
+                      f"scripts/build_native.sh")
+        return None
+    _bind_optional(lib)
+    _lib = lib
+    return lib
+
+
+def _bind_core(lib: ctypes.CDLL) -> None:
     lib.hm_murmur3_x86_32.restype = ctypes.c_int32
     lib.hm_murmur3_x86_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                       ctypes.c_uint32]
@@ -63,6 +87,11 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
         ctypes.c_void_p,
     ]
+
+
+def _bind_optional(lib: ctypes.CDLL) -> None:
+    """Per-symbol guards: these entry points may be absent from older .so
+    builds without invalidating the core library."""
     try:
         lib.hm_lattice_tokenize_bulk.restype = ctypes.c_int64
         lib.hm_lattice_tokenize_bulk.argtypes = [
@@ -104,8 +133,6 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
     except AttributeError:  # older .so without the parser
         pass
-    _lib = lib
-    return lib
 
 
 def available() -> bool:

@@ -30,11 +30,14 @@ class EtaEstimator:
         if self.kind == "fixed":
             return jnp.full_like(t, self.eta0)
         if self.kind == "simple":
-            final_eta = self.eta0 / 2.0
+            # literals pinned to the schedule dtype so x64/np-scalar mixing
+            # cannot promote the eta feeding every weight update
+            # (graftcheck G003; bf16 storage policy in models/base.py)
+            eta0 = jnp.asarray(self.eta0, t.dtype)
             return jnp.where(
                 t > self.total_steps,
-                final_eta,
-                self.eta0 / (1.0 + t / self.total_steps),
+                eta0 / 2,
+                eta0 / (1 + t / self.total_steps),
             )
         if self.kind == "invscaling":
             return self.eta0 / jnp.power(jnp.maximum(t, 1.0), self.power_t)

@@ -23,9 +23,23 @@ class LossFunction(NamedTuple):
     is_binary: bool
 
 
+def _pin(value, like):
+    """Pin a literal to the operand's dtype (graftcheck G003): under
+    jax_enable_x64 or numpy-scalar mixing a bare float literal can promote
+    the whole update expression, silently upcasting the bf16-above-2^24
+    storage policy of models/base.py. Dtype-matched, the constant follows
+    the data — identical numerics under the default config. Non-float
+    operands (int labels through the public loss API) pin to the default
+    float dtype instead, matching weak-literal promotion."""
+    dt = jnp.result_type(like)
+    if not jnp.issubdtype(dt, jnp.floating):
+        dt = jnp.result_type(float)
+    return jnp.asarray(value, dt)
+
+
 def _squared_loss(p, y):
     z = p - y
-    return 0.5 * z * z
+    return _pin(0.5, z) * z * z
 
 
 def _squared_dloss(p, y):
@@ -41,7 +55,7 @@ def _log_loss(p, y):
 
 def _log_dloss(p, y):
     z = y * p
-    return -y / (jnp.exp(z) + 1.0)
+    return -y / (jnp.exp(z) + _pin(1.0, z))
 
 
 def _hinge_loss(p, y, threshold=1.0):
@@ -53,23 +67,23 @@ def _hinge_dloss(p, y, threshold=1.0):
 
 
 def _squared_hinge_loss(p, y):
-    d = jnp.maximum(0.0, 1.0 - y * p)
+    d = jnp.maximum(0.0, _pin(1.0, p) - y * p)
     return d * d
 
 
 def _squared_hinge_dloss(p, y):
-    d = 1.0 - y * p
-    return jnp.where(d > 0.0, -2.0 * d * y, 0.0)
+    d = _pin(1.0, p) - y * p
+    return jnp.where(d > 0.0, _pin(-2.0, d) * d * y, 0.0)
 
 
 def _quantile_loss(p, y, tau=0.5):
     e = y - p
-    return jnp.where(e > 0.0, tau * e, -(1.0 - tau) * e)
+    return jnp.where(e > 0.0, tau * e, -(_pin(1.0, e) - tau) * e)
 
 
 def _quantile_dloss(p, y, tau=0.5):
     e = y - p
-    return jnp.where(e == 0.0, 0.0, jnp.where(e > 0.0, -tau, 1.0 - tau))
+    return jnp.where(e == 0.0, 0.0, jnp.where(e > 0.0, -tau, _pin(1.0, e) - tau))
 
 
 def _eps_insensitive_loss(p, y, epsilon=0.1):
@@ -107,8 +121,9 @@ def get_loss_function(name: str) -> LossFunction:
 def logistic_loss(target, predicted):
     """logisticLoss(target, predicted) for probability targets
     (ref: LossFunctions.java:381-392)."""
+    one = _pin(1.0, predicted)
     return jnp.where(
         predicted > -100.0,
-        target - 1.0 / (1.0 + jnp.exp(-predicted)),
+        target - one / (one + jnp.exp(-predicted)),
         target,
     )

@@ -257,7 +257,9 @@ class FMShardedTrainer:
     def step(self, state, indices, values, labels, va=None):
         """indices/values: [B, K]; labels: [B] (replicated)."""
         if va is None:
-            va = np.zeros(np.asarray(labels).shape, np.float32)
+            # np.shape reads the .shape attribute — no device->host copy of
+            # the labels block on the per-step path (graftcheck G002)
+            va = np.zeros(np.shape(labels), np.float32)
         return self._step(state, indices, values, labels, va)
 
     def final_state(self, state):

@@ -114,6 +114,82 @@ class MetricsRegistry:
 REGISTRY = MetricsRegistry()
 
 
+def _jit_cache_size(fn) -> int:
+    """Compile-cache entry count of a jax.jit product (0 when unknown)."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return 0
+    try:
+        return int(probe())
+    except Exception:
+        return 0
+
+
+class recompile_guard:
+    """Count jit cache misses per named step function — the runtime witness
+    for graftcheck's G001 recompile-hazard rule (hivemall_tpu/analysis).
+
+    Wrap the steady-state section of a training loop::
+
+        step = make_train_step(rule, hyper)
+        with recompile_guard("arow_minibatch", step) as g:
+            for block in blocks:
+                state, loss = step(state, *block)
+        g.compiles  # cache misses INSIDE the block; 0 after warmup
+
+    Every exit increments the process-wide counter
+    ``graftcheck.recompiles.<name>`` and sets the gauge
+    ``<name>.jit_cache_entries`` to the functions' total cache size, so the
+    /metrics endpoint (runtime/metrics_http.py) exposes
+
+        hivemall_tpu_graftcheck_recompiles_<name>
+        hivemall_tpu_<name>_jit_cache_entries
+
+    and a static G001 finding can be confirmed on hardware: a step function
+    recompiling per invocation shows a recompile counter growing linearly
+    with steps (the recompilation-count production metric of the ads-infra
+    paper, PAPERS.md). ``expect_stable=True`` raises on any miss — used by
+    tests and scripts/profile_step.py to pin the steady state.
+    """
+
+    def __init__(self, name: str, *jitted_fns, registry: "MetricsRegistry" = None,
+                 expect_stable: bool = False) -> None:
+        self.name = name
+        self.fns = jitted_fns
+        self.registry = registry if registry is not None else REGISTRY
+        self.expect_stable = expect_stable
+        self.compiles = 0
+        self._start: list = []
+
+    def __enter__(self) -> "recompile_guard":
+        if self.expect_stable and self.fns and not any(
+                getattr(f, "_cache_size", None) is not None
+                for f in self.fns):
+            # a guard that cannot observe the cache must not certify
+            # stability — fail fast instead of silently reporting 0 misses
+            raise RuntimeError(
+                f"recompile_guard({self.name!r}, expect_stable=True): none "
+                f"of the guarded functions expose a jit cache-size probe "
+                f"(_cache_size) — pass jax.jit products")
+        self._start = [_jit_cache_size(f) for f in self.fns]
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        sizes = [_jit_cache_size(f) for f in self.fns]
+        self.compiles = sum(max(0, now - was)
+                            for was, now in zip(self._start, sizes))
+        self.registry.counter("graftcheck",
+                              f"recompiles.{self.name}").increment(
+            self.compiles)
+        self.registry.set_gauge(f"{self.name}.jit_cache_entries",
+                                float(sum(sizes)))
+        if exc_type is None and self.expect_stable and self.compiles:
+            raise RuntimeError(
+                f"recompile_guard({self.name!r}): {self.compiles} jit cache "
+                f"miss(es) in a section expected steady — a G001-class "
+                f"hazard is retracing the step function")
+
+
 @contextlib.contextmanager
 def trace(name: str, log_dir: Optional[str] = None) -> Iterator[None]:
     """Wrap a block in the JAX profiler (xprof trace) when log_dir is given;

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# graftcheck gate (hivemall_tpu/analysis): JAX/TPU-aware static analysis.
+#
+#   scripts/lint.sh            # changed-files mode (<5s): files touched vs
+#                              # HEAD (staged + unstaged + untracked)
+#   scripts/lint.sh --all      # full-tree scan of hivemall_tpu/
+#   scripts/lint.sh FILES...   # explicit file list
+#
+# Exits non-zero on any finding not covered by analysis/baseline.json.
+# Accepted debt is refreshed with:
+#   python -m hivemall_tpu.analysis --update-baseline
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--all" ]]; then
+  exec python -m hivemall_tpu.analysis hivemall_tpu/
+elif [[ $# -gt 0 ]]; then
+  exec python -m hivemall_tpu.analysis "$@"
+fi
+
+# changed-files mode: python files under hivemall_tpu/ touched since HEAD
+# (portable read loop — macOS stock bash 3.2 has no mapfile builtin)
+existing=()
+while IFS= read -r f; do
+  if [[ -n "$f" && -f "$f" ]]; then  # drop deleted paths (set -e safe)
+    existing+=("$f")
+  fi
+done < <(
+  {
+    git diff --name-only HEAD -- 'hivemall_tpu/**/*.py' 'hivemall_tpu/*.py'
+    git ls-files --others --exclude-standard -- 'hivemall_tpu/**/*.py' \
+      'hivemall_tpu/*.py'
+  } | sort -u)
+if [[ ${#existing[@]} -eq 0 ]]; then
+  echo "graftcheck: no changed python files under hivemall_tpu/"
+  exit 0
+fi
+exec python -m hivemall_tpu.analysis "${existing[@]}"

@@ -2,22 +2,47 @@
 
 Times (a) full step, (b) gather+math only, (c) each scatter variant, to find
 where the ~10ms/step goes (PERF.md optimization plan step 1).
+
+Compile time and steady-state step time are reported SEPARATELY: the first
+call is timed under `recompile_guard` (runtime/metrics.py), which counts jit
+cache misses, and the steady loop runs under `expect_stable=True` so a
+kernel that silently retraces per call (a G001 recompile hazard) fails the
+benchmark loudly instead of publishing a compile-dominated number.
 """
+import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def timeit(fn, *args, n=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(n):
+from hivemall_tpu.runtime.metrics import recompile_guard
+
+
+def timeit(name, fn, *args, n=20):
+    """-> (compile_ms, steady_ms, n_compiles). First call timed apart from
+    the steady loop; cache misses counted per phase."""
+    with recompile_guard(f"profile.{name}.warmup", fn) as warm:
+        t0 = time.perf_counter()
         out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n * 1e3  # ms
+        jax.block_until_ready(out)
+        compile_ms = (time.perf_counter() - t0) * 1e3
+    with recompile_guard(f"profile.{name}", fn, expect_stable=True):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        steady_ms = (time.perf_counter() - t0) / n * 1e3
+    return compile_ms, steady_ms, warm.compiles
+
+
+def report(name, fn, *args, n=20):
+    compile_ms, steady_ms, misses = timeit(name, fn, *args, n=n)
+    print(f"{name:<17}: {steady_ms:8.3f} ms/step steady | "
+          f"first call {compile_ms:8.1f} ms ({misses} compile)")
 
 
 def main():
@@ -68,15 +93,15 @@ def main():
     def full_d_pass(w, dw_sum, counts):
         return w + dw_sum / jnp.maximum(counts, 1.0)
 
+    report("gather+math", gather_math, w, cov, idx, val, lab)
     dw, dcov = gather_math(w, cov, idx, val, lab)
     upd = jnp.ones_like(dw)
-    print("gather+math      :", round(timeit(gather_math, w, cov, idx, val, lab), 3), "ms")
-    print("one scatter [D]  :", round(timeit(one_scatter, w, idx, dw), 3), "ms")
-    print("fused [D,3] scat :", round(timeit(scatter_into_2d, w, idx, dw, dcov, upd), 3), "ms")
-    print("sort+scatter     :", round(timeit(sort_segsum, w, idx, dw), 3), "ms")
+    report("one scatter [D]", one_scatter, w, idx, dw)
+    report("fused [D,3] scat", scatter_into_2d, w, idx, dw, dcov, upd)
+    report("sort+scatter", sort_segsum, w, idx, dw)
     dw_sum = one_scatter(w, idx, dw)
     counts = one_scatter(w, idx, upd)
-    print("full-D pass      :", round(timeit(full_d_pass, w, dw_sum, counts), 3), "ms")
+    report("full-D pass", full_d_pass, w, dw_sum, counts)
 
     # int8 touched scatter-max
     touched = jnp.zeros((dims,), jnp.int8)
@@ -86,7 +111,7 @@ def main():
         return t.at[idx].max(lane, mode="drop")
 
     lane = jnp.ones_like(idx, jnp.int8)
-    print("touched max int8 :", round(timeit(touch_max, touched, idx, lane), 3), "ms")
+    report("touched max int8", touch_max, touched, idx, lane)
 
 
 if __name__ == "__main__":

@@ -6,4 +6,10 @@
 # TPU chip is used by bench.py only.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# tier-1 gate 1: graftcheck static analysis on changed files (<5s) — any
+# new non-baselined recompile/host-sync/dtype/axis/donation/side-effect
+# finding fails before pytest spends minutes (docs/static_analysis.md)
+bash scripts/lint.sh
+
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

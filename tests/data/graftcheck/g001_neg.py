@@ -1,0 +1,42 @@
+"""Known-negative G001 cases: trace-safe control flow."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def structure_check(cov, w):
+    if cov is not None:  # pytree structure: static under trace
+        return w * cov
+    return w
+
+
+@partial(jax.jit, static_argnums=(1,))
+def static_branch(x, mode):
+    if mode == "relu":  # static arg: a Python constant per trace
+        return jnp.maximum(x, 0)
+    return x
+
+
+def make_scaled_step(scale_by_two):
+    def scaled_step(x):
+        if scale_by_two:  # closure var: Python constant at trace time
+            return x * 2
+        return x
+
+    return jax.jit(scaled_step, donate_argnums=(0,))
+
+
+@jax.jit
+def data_dependent_value_flow(x):
+    return jnp.where(x > 0, x, -x)
+
+
+@jax.jit
+def membership_on_structure(slots, deltas):
+    out = dict(slots)
+    for k in ("g", "u"):
+        if k in deltas:  # dict-key membership: static structure
+            out[k] = slots[k] + deltas[k]
+    return out

@@ -1,0 +1,36 @@
+"""Known-positive G001 recompile-hazard cases (parsed, never imported)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_traced(x, threshold):
+    if x > threshold:  # EXPECT: G001
+        return x
+    return -x
+
+
+@jax.jit
+def while_on_traced(x):
+    while x < 10:  # EXPECT: G001
+        x = x + 1
+    return x
+
+
+@jax.jit
+def shape_keyed_fstring(x):
+    key = f"block-{x.shape}"  # EXPECT: G001
+    return x, key
+
+
+def rejit_in_loop(blocks, fn):
+    out = []
+    for blk in blocks:
+        stepper = jax.jit(fn)  # EXPECT: G001
+        out.append(stepper(blk))
+    return out
+
+
+def data_dependent_statics(fn, batch):
+    nums = tuple(range(batch.ndim))
+    return jax.jit(fn, static_argnums=nums)  # EXPECT: G001

@@ -1,0 +1,29 @@
+"""Known-negative G003 cases: pinned or promotion-safe constants.
+
+# graftcheck: dtype-module
+"""
+import jax.numpy as jnp
+
+
+def _pin(value, like):
+    return jnp.asarray(value, jnp.result_type(like))
+
+
+def pinned_half_squared(z):
+    return _pin(0.5, z) * z * z
+
+
+def call_arg_literal(x):
+    return jnp.maximum(x, 1.0)  # call args follow weak promotion: fine
+
+
+def integer_literals(t):
+    return t / 2 + 1  # int literals never widen a float dtype
+
+
+def comparison_threshold(p):
+    return jnp.where(p > -100.0, p, 0.0)
+
+
+def explicit_f32(xs):
+    return jnp.asarray(xs, jnp.float32)

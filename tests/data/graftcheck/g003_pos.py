@@ -1,0 +1,24 @@
+"""Known-positive G003 dtype-drift cases.  # graftcheck: dtype-module"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def unpinned_eta(eta0, t):
+    denom = 1.0 + t  # EXPECT: G003
+    return eta0 / denom
+
+
+def unpinned_half_squared(z):
+    return 0.5 * z * z  # EXPECT: G003
+
+
+def f64_staging(xs):
+    return np.asarray(xs, dtype=np.float64)  # EXPECT: G003
+
+
+def f64_cast(w):
+    return w.astype(float)  # EXPECT: G003
+
+
+def f64_scalar(x):
+    return np.float64(x)  # EXPECT: G003

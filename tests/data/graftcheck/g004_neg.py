@@ -1,0 +1,32 @@
+"""Known-negative G004 cases: declared or variable axis names."""
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+LOCAL_AXIS = "rows"  # *_AXIS module constant: a declaration
+
+
+def registry_axis(x):
+    return jax.lax.psum(x, "workers")  # declared in parallel/mesh.py
+
+
+def registry_shard_axis(x):
+    return jax.lax.pmean(x, "shards")
+
+
+def variable_axis(x, axis):
+    return jax.lax.psum(x, axis)  # variables trace back to the registry
+
+
+def local_constant_axis(x):
+    return jax.lax.psum(x, LOCAL_AXIS)
+
+
+def local_literal_after_declaration(x):
+    return jax.lax.pmax(x, "rows")
+
+
+def private_mesh_axis(devices, x):
+    mesh = Mesh(np.asarray(devices), ("pipeline",))
+    with mesh:
+        return jax.lax.psum(x, "pipeline")

@@ -1,0 +1,14 @@
+"""Known-positive G004 axis-name-mismatch cases."""
+import jax
+
+
+def typoed_psum(x):
+    return jax.lax.psum(x, "worker")  # EXPECT: G004
+
+
+def typoed_axis_index():
+    return jax.lax.axis_index("replicas")  # EXPECT: G004
+
+
+def typoed_kwarg(x):
+    return jax.lax.pmean(x, axis_name="shard")  # EXPECT: G004

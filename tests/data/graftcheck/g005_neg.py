@@ -1,0 +1,25 @@
+"""Known-negative G005 cases: donation done right."""
+import jax
+
+
+def train_step(state, blk):
+    return state, 0.0
+
+
+def score(w, x):
+    return w @ x
+
+
+donating_step = jax.jit(train_step, donate_argnums=(0,))
+predict = jax.jit(score)  # predict-shaped: inputs reused by design
+
+
+def rebind_is_fine(state, blocks):
+    for blk in blocks:
+        state, loss = donating_step(state, blk)
+    return state, loss
+
+
+def fresh_name_never_rereads(state, blk):
+    new_state, loss = donating_step(state, blk)
+    return new_state, loss

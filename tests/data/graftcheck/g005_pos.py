@@ -1,0 +1,16 @@
+"""Known-positive G005 donation-misuse cases."""
+import jax
+
+
+def train_step(state, blk):
+    return state, 0.0
+
+
+undonated = jax.jit(train_step)  # EXPECT: G005
+
+donating_step = jax.jit(train_step, donate_argnums=(0,))
+
+
+def read_after_donate(state, blk):
+    new_state, loss = donating_step(state, blk)
+    return state, loss  # EXPECT: G005

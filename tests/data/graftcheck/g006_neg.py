@@ -1,0 +1,29 @@
+"""Known-negative G006 cases: sanctioned or local effects."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def debug_print_ok(x):
+    jax.debug.print("x = {}", x)  # the sanctioned per-step effect
+    return x
+
+
+@jax.jit
+def local_mutation_ok(slots, x):
+    new_slots = dict(slots)
+    new_slots["g"] = x
+    acc = []
+    acc.append(x)
+    return new_slots, acc
+
+
+@jax.jit
+def jax_rng_ok(key):
+    return jax.random.normal(key, (4,))
+
+
+def host_loop_metrics_ok(blocks, counter):
+    for blk in blocks:
+        counter.increment()  # host side: counts real steps
+    return blocks

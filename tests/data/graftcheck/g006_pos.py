@@ -1,0 +1,39 @@
+"""Known-positive G006 untraced-side-effect cases."""
+import time
+
+import jax
+import numpy as np
+
+COUNTER = {"steps": 0}
+LOG = []
+
+
+@jax.jit
+def print_in_trace(state, x):
+    print("step!", x)  # EXPECT: G006
+    return state + x
+
+
+@jax.jit
+def metrics_in_trace(counter, x):
+    counter.increment()  # EXPECT: G006
+    return x
+
+
+@jax.jit
+def clock_in_trace(x):
+    t0 = time.perf_counter()  # EXPECT: G006
+    return x * t0
+
+
+@jax.jit
+def numpy_rng_in_trace(x):
+    noise = np.random.randn()  # EXPECT: G006
+    return x + noise
+
+
+@jax.jit
+def closure_mutation(x):
+    LOG.append(x)  # EXPECT: G006
+    COUNTER["steps"] += 1  # EXPECT: G006
+    return x

@@ -1,0 +1,30 @@
+"""Inline-suppression fixture: every hazard here is explicitly accepted.
+
+# graftcheck: hot-module
+"""
+import jax
+import numpy as np
+
+
+def make_train_step(rule):
+    return jax.jit(rule, donate_argnums=(0,))
+
+
+def tolerated_sync(state, blocks, rule):
+    stepper = make_train_step(rule)
+    total = 0.0
+    for blk in blocks:
+        state, loss = stepper(state, blk)
+        total += float(loss)  # graftcheck: disable=G002
+    return state, total
+
+
+# file-level: accept the step-shaped undonated wrapper below
+# graftcheck: disable-file=G005
+
+
+def eval_step(state, blk):
+    return state, 0.0
+
+
+undonated_eval = jax.jit(eval_step)
