@@ -17,12 +17,71 @@ from .findings import Finding, Severity
 from .runner import analyze_paths, iter_python_files, normalize_path
 
 
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(DEFAULT_BASELINE))))
+
+
+def _new_findings(findings, paths, args):
+    """Findings the baseline gate would report (all of them under
+    --no-baseline) — the set --fix/--fix-check operates on, so
+    baseline-accepted debt never fails the fix gate."""
+    if args.no_baseline:
+        return list(findings)
+    scanned = [normalize_path(p) for p in iter_python_files(paths)]
+    new, _ = diff_against_baseline(findings, load_baseline(args.baseline),
+                                   scanned_paths=scanned)
+    return new
+
+
+def _run_fixes(findings, rules, check_only: bool, args) -> int:
+    """--fix / --fix-check: plan every attached fix, show the diff, then
+    (fix mode) write and re-scan the touched files to confirm the repairs
+    landed. Idempotent by construction: applied fixes remove their own
+    findings, so a second run plans nothing."""
+    from .fixer import plan_fixes, render_diffs, write_fixes
+
+    root = _repo_root()
+    planned, notes = plan_fixes(findings, root=root)
+    for note in notes:
+        print(f"note: {note}")
+    if not planned:
+        print("graftcheck: no applicable fixes"
+              + (" (clean)" if check_only else ""))
+        return 0
+    diff = render_diffs(planned)
+    print(diff, end="" if diff.endswith("\n") else "\n")
+    if check_only:
+        print(f"graftcheck: --fix would modify {len(planned)} file(s) — "
+              f"run `python -m hivemall_tpu.analysis --fix`")
+        return 1
+    written = write_fixes(planned, root=root)
+    print(f"graftcheck: fixed {len(written)} file(s): "
+          + ", ".join(written))
+    from .fixer import finding_fs_path
+    fixed_paths = [finding_fs_path(p, root) for p in written]
+    rescanned = _new_findings(analyze_paths(fixed_paths, rules=rules),
+                              fixed_paths, args)
+    refixable = [f for f in rescanned if f.fix is not None]
+    if refixable:
+        print("graftcheck: WARNING — findings with fixes remain after "
+              "applying:")
+        for f in refixable:
+            print("  " + f.format())
+        return 1
+    print("graftcheck: re-scan of fixed files reports no remaining "
+          "fixable findings")
+    return 0
+
+
 def main(argv: List[str] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m hivemall_tpu.analysis",
         description="graftcheck: JAX/TPU-aware static analysis "
                     "(recompile / host-sync / dtype / axis / donation / "
-                    "side-effect hazards)")
+                    "side-effect hazards, plus interprocedural SPMD/"
+                    "collective safety G007-G011 with a --fix autofix "
+                    "engine)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: hivemall_tpu)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -35,6 +94,16 @@ def main(argv: List[str] = None) -> int:
                     help="comma-separated rule subset (e.g. G001,G002)")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply machine-applicable fixes (with a unified-"
+                         "diff preview), then re-scan the fixed files")
+    ap.add_argument("--fix-check", action="store_true",
+                    help="exit 1 if --fix would change anything (CI guard);"
+                         " prints the would-be diff, writes nothing")
+    ap.add_argument("--with-callers", action="store_true",
+                    help="also scan package modules that (transitively) "
+                         "import the given paths — interprocedural rules "
+                         "can fire in an unchanged caller")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -44,17 +113,37 @@ def main(argv: List[str] = None) -> int:
         return 0
 
     paths = args.paths or ["hivemall_tpu"]
+    # a typo'd path must be a loud usage error, not a silent 'clean' exit —
+    # a CI gate pointed at nothing would otherwise check nothing
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print("graftcheck: no such path(s): " + ", ".join(missing),
+              file=sys.stderr)
+        return 2
+    if not any(True for _ in iter_python_files(paths)):
+        print("graftcheck: no python files under: " + ", ".join(paths),
+              file=sys.stderr)
+        return 2
     rules = [r.strip().upper() for r in args.rules.split(",")] \
         if args.rules else None
+    if args.with_callers:
+        from .runner import expand_to_callers
+        paths = expand_to_callers(paths)
     findings = analyze_paths(paths, rules=rules)
+
+    if args.fix or args.fix_check:
+        # fix only what the baseline gate would report: baseline-accepted
+        # debt must not fail --fix-check (the documented --update-baseline
+        # workflow has to unblock CI)
+        return _run_fixes(_new_findings(findings, paths, args), rules,
+                          check_only=args.fix_check, args=args)
 
     if args.update_baseline:
         # a partial scan refreshes only the scanned files' entries; accepted
         # debt in unscanned (still-existing) files is carried over so
         # `lint.sh <file> --update-baseline`-style runs can't clobber it
         scanned = {normalize_path(p) for p in iter_python_files(paths)}
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(DEFAULT_BASELINE))))
+        repo_root = _repo_root()
         carried = [b for b in load_baseline(args.baseline)
                    if b.path not in scanned
                    and os.path.exists(os.path.join(repo_root,

@@ -9,8 +9,8 @@ line itself surfaces it again.
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass
-from typing import Dict, List, Set
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
 
 
 class Severity:
@@ -21,13 +21,34 @@ class Severity:
 
 
 @dataclass(frozen=True)
+class Edit:
+    """One within-line text replacement: on `line`, the first occurrence of
+    `old` becomes `new`. Within-line edits never shift other findings' line
+    numbers, so every fix collected in one scan applies in one pass."""
+    line: int  # 1-based
+    old: str
+    new: str
+
+
+@dataclass(frozen=True)
+class Fix:
+    """A machine-applicable repair attached to a finding. `add_import` is
+    (module, name) — the fixer merges all requested names per module into
+    one import statement and inserts/extends it idempotently."""
+    edits: Tuple[Edit, ...] = ()
+    add_import: Optional[Tuple[str, str]] = None
+
+
+@dataclass(frozen=True)
 class Finding:
     path: str  # repo-relative, forward slashes
     line: int  # 1-based
-    rule: str  # "G001".."G006" ("G000" = parse failure)
+    rule: str  # "G001".."G011" ("G000" = parse failure)
     severity: str  # Severity.*
     message: str
     snippet: str  # stripped source of the flagged line (baseline key)
+    # optional autofix; not part of identity/baseline and not serialized
+    fix: Optional[Fix] = field(default=None, compare=False)
 
     @property
     def key(self):

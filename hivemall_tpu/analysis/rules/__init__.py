@@ -1,4 +1,8 @@
-"""Rule registry: each rule module exports RULE_ID and check(model)."""
+"""Rule registry.
+
+Module rules export ``RULE_ID`` and ``check(model)``; program rules export
+``RULE_ID`` and ``check_program(program, scanned)`` — the runner dispatches
+each tier (runner._run_rules)."""
 
 from __future__ import annotations
 
@@ -7,15 +11,24 @@ from typing import Callable, Dict, List
 from ..findings import Finding
 from ..modmodel import ModuleModel
 from . import (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
-               g005_donation, g006_side_effect)
+               g005_donation, g006_side_effect, g007_collective_axis,
+               g008_spec_mesh, g009_api_compat, g010_unreduced_output,
+               g011_divergent_collective)
 
-_MODULES = (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
-            g005_donation, g006_side_effect)
+_MODULE_RULES = (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
+                 g005_donation, g006_side_effect, g009_api_compat)
+_PROGRAM_RULES = (g007_collective_axis, g008_spec_mesh,
+                  g010_unreduced_output, g011_divergent_collective)
 
 ALL_RULES: Dict[str, Callable[[ModuleModel], List[Finding]]] = {
-    m.RULE_ID: m.check for m in _MODULES
+    m.RULE_ID: m.check for m in _MODULE_RULES
+}
+
+PROGRAM_RULES: Dict[str, Callable] = {
+    m.RULE_ID: m.check_program for m in _PROGRAM_RULES
 }
 
 RULE_DOCS: Dict[str, str] = {
-    m.RULE_ID: (m.__doc__ or "").strip().splitlines()[0] for m in _MODULES
+    m.RULE_ID: (m.__doc__ or "").strip().splitlines()[0]
+    for m in _MODULE_RULES + _PROGRAM_RULES
 }

@@ -28,13 +28,21 @@ RULE_ID = "G004"
 _AXIS_KWARGS = ("axis_name", "axis_names", "replica_axis", "shard_axis")
 
 
+_MESH_AXES_CACHE: dict = {}
+
+
 def _mesh_file_axes() -> Set[str]:
     """Module-level string constants of parallel/mesh.py, parsed (not
-    imported — graftcheck must not pull in jax)."""
+    imported — graftcheck must not pull in jax) and mtime-cached: a full
+    -tree scan calls this once per scanned module."""
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     mesh_py = os.path.join(os.path.dirname(here), "parallel", "mesh.py")
     axes: Set[str] = set()
     try:
+        mtime = os.path.getmtime(mesh_py)
+        cached = _MESH_AXES_CACHE.get(mesh_py)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
         with open(mesh_py, "r", encoding="utf-8") as fh:
             tree = ast.parse(fh.read())
     except (OSError, SyntaxError):
@@ -46,6 +54,7 @@ def _mesh_file_axes() -> Set[str]:
                 and any(isinstance(t, ast.Name) and t.id.endswith("_AXIS")
                         for t in node.targets):
             axes.add(node.value.value)
+    _MESH_AXES_CACHE[mesh_py] = (mtime, axes)
     return axes
 
 

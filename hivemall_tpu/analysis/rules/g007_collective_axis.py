@@ -1,0 +1,67 @@
+"""G007 collective-axis-not-bound: psum over an axis the shard_map lacks.
+
+A collective names a mesh axis; that axis must be bound by the enclosing
+``shard_map``'s mesh or the program dies at run time (on hardware, inside
+the compiled step) with an unbound-axis error — or worse, silently reduces
+over the wrong axis on a 2-D mesh. The hazard hides *interprocedurally*:
+the psum usually sits in a helper (``mix_average`` in ``parallel/mix.py``,
+the histogram bodies in ``models/trees/grow.py``) several calls below the
+``shard_map`` site that binds the axes.
+
+For every shard_map site whose mesh expression resolves to a literal
+axis-name set, the rule walks the body's call graph (through factory
+returns, function-valued arguments, and string arguments propagated edge
+by edge — see program.py) and checks every collective whose axis resolves
+to a literal. Both ends must be provable: unknown meshes and dynamic axis
+names are trusted, exactly like G004.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from ..findings import Finding, Severity
+from ..program import ProgramModel
+
+RULE_ID = "G007"
+
+
+def check_program(program: ProgramModel, scanned: Set[str]
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+    for site in program.shard_map_sites():
+        model = program.modules.get(site.module)
+        if model is None:
+            continue
+        scope = model.enclosing_function(site.call)
+        axes = program.mesh_axes(site.module, site.mesh_expr, scope)
+        if not axes:
+            continue
+        body = program.resolve_callable(site.module, site.fn_expr)
+        if body is None:
+            continue
+        b_path, b_fn, b_env = body
+        for f_path, f_fn, summ, env in program.walk_calls(
+                b_path, b_fn, b_env):
+            for call, tail, kind, value in summ.collectives:
+                axis = program.resolve_axis(f_path, f_fn, kind, value, env)
+                if axis is None or axis in axes:
+                    continue
+                if f_path not in scanned:
+                    continue
+                f_model = program.modules[f_path]
+                key = (f_path, call.lineno, tail, axis, site.module,
+                       site.call.lineno)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    f_path, call.lineno, RULE_ID, Severity.ERROR,
+                    f"collective `{tail}` over axis '{axis}' which is not "
+                    f"bound by the enclosing shard_map at "
+                    f"{site.module}:{site.call.lineno} (mesh axes: "
+                    f"{', '.join(sorted(axes))}) — unbound collective axes "
+                    f"fail only at run time inside the compiled step",
+                    f_model.snippet(call.lineno)))
+    return findings

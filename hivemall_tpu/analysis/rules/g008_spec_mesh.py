@@ -1,0 +1,113 @@
+"""G008 partition-spec/mesh mismatch: P(...) axes the mesh does not have.
+
+A ``PartitionSpec`` names mesh axes; naming one the mesh lacks —
+``P(SHARD_AXIS)`` under a 1-D ``make_mesh()`` that only binds ``workers``,
+or a typo'd literal in ``NamedSharding(mesh, P("model"))`` — is accepted
+at trace time on some paths and explodes (or silently replicates) at
+placement time. The declarations live in ``parallel/mesh.py``; the uses
+are spread over every trainer, so the check is cross-module: resolve the
+mesh expression at each ``shard_map`` and ``NamedSharding(mesh, spec)``
+site to its axis-name set (program.py), then validate every axis literal
+(or constant resolvable to one) inside the specs against it.
+
+Both ends must be provable; specs built dynamically (``jax.tree.map``
+lambdas, computed tuples) are trusted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..findings import Finding, Severity
+from ..modmodel import dotted_name
+from ..program import ProgramModel
+
+RULE_ID = "G008"
+
+_SPEC_CALLEES = ("P", "PartitionSpec")
+
+
+def _spec_axis_literals(program: ProgramModel, path: str,
+                        expr: Optional[ast.expr]
+                        ) -> Iterator[Tuple[ast.AST, str]]:
+    """(node, axis string) for every provable axis name inside P(...) calls
+    of a spec expression (tuples of specs, nested axis tuples)."""
+    if expr is None:
+        return
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        if callee.rsplit(".", 1)[-1] not in _SPEC_CALLEES:
+            continue
+        stack = list(node.args)
+        while stack:
+            arg = stack.pop()
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                stack.extend(arg.elts)
+            elif isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                yield node, arg.value
+            elif isinstance(arg, ast.Name):
+                s = program.resolve_str(path, arg.id)
+                if s is not None:
+                    yield node, s
+
+
+def check_program(program: ProgramModel, scanned: Set[str]
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+
+    def flag(path: str, node: ast.AST, axis: str, axes: Set[str],
+             where: str) -> None:
+        if path not in scanned:
+            return
+        key = (path, node.lineno, axis, where)
+        if key in seen:
+            return
+        seen.add(key)
+        model = program.modules[path]
+        findings.append(Finding(
+            path, node.lineno, RULE_ID, Severity.ERROR,
+            f"PartitionSpec names axis '{axis}' but the {where} mesh only "
+            f"binds ({', '.join(sorted(axes))}) — the spec cannot be "
+            f"honored and fails (or silently replicates) at placement "
+            f"time", model.snippet(node.lineno)))
+
+    # shard_map sites: in_specs/out_specs vs the site's mesh
+    for site in program.shard_map_sites():
+        model = program.modules.get(site.module)
+        if model is None or site.module not in scanned:
+            continue
+        scope = model.enclosing_function(site.call)
+        axes = program.mesh_axes(site.module, site.mesh_expr, scope)
+        if not axes:
+            continue
+        for spec_expr in (site.in_specs_expr, site.out_specs_expr):
+            for node, axis in _spec_axis_literals(program, site.module,
+                                                  spec_expr):
+                if axis not in axes:
+                    flag(site.module, node, axis, axes, "shard_map")
+
+    # NamedSharding(mesh, spec) / pjit(..., in_shardings=...) style sites
+    for path in scanned:
+        model = program.modules.get(path)
+        if model is None:
+            continue
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func) or ""
+            tail = callee.rsplit(".", 1)[-1]
+            if tail == "NamedSharding" and len(node.args) >= 2:
+                scope = model.enclosing_function(node)
+                axes = program.mesh_axes(path, node.args[0], scope)
+                if not axes:
+                    continue
+                for spec_node, axis in _spec_axis_literals(
+                        program, path, node.args[1]):
+                    if axis not in axes:
+                        flag(path, spec_node, axis, axes, "NamedSharding")
+    return findings

@@ -1,0 +1,123 @@
+"""G009 version-incompatible-jax-api: raw shard_map/pcast spellings.
+
+The shard_map API surface moved across jax versions (``jax.shard_map`` +
+``check_vma=`` vs ``jax.experimental.shard_map`` + ``check_rep=``; the
+vma-era ``jax.lax.pcast`` does not exist before it). A direct call to
+either spelling pins the module to one side of the fence and dies with an
+``AttributeError``/``TypeError`` on the other — exactly how this repo's
+entire distributed subsystem (48 tier-1 tests) was dead against the
+installed jax. The portable surface is ``runtime/jax_compat.py``; every
+finding carries a machine-applicable fix (``--fix``) that rewrites the
+callee to the compat export and routes the import through it.
+
+Severity: error when the installed jax (package metadata or
+``GRAFTCHECK_JAX_VERSION``) provably lacks the API — the code cannot run
+here; warning otherwise — it runs today but breaks on the other side of
+the version fence.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..apicompat import (API_BY_DOTTED, COMPAT_MODULE_PATH,
+                         LEGACY_IMPORT_MODULES, available_in,
+                         compat_import_module, installed_jax_version)
+from ..findings import Edit, Finding, Fix, Severity
+from ..modmodel import ModuleModel, dotted_name
+
+RULE_ID = "G009"
+
+
+def _grade(entry, version) -> str:
+    avail = available_in(entry, version)
+    return Severity.ERROR if avail is False else Severity.WARNING
+
+
+def _version_clause(entry, version) -> str:
+    if available_in(entry, version) is False:
+        v = ".".join(str(p) for p in version)
+        return f"not available in the installed jax {v}"
+    return "version-fragile (exists only on one side of the shard_map " \
+           "API migration)"
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    if model.rel_path == COMPAT_MODULE_PATH:
+        return []  # the portability layer itself touches both spellings
+    version = installed_jax_version()
+    compat_mod = compat_import_module(model.rel_path)
+    findings: List[Finding] = []
+
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func)
+            entry = API_BY_DOTTED.get(callee) if callee else None
+            if entry is None:
+                continue
+            fix: Optional[Fix] = Fix(
+                edits=(Edit(node.lineno, callee + "(",
+                            entry.compat_name + "("),),
+                add_import=(compat_mod, entry.compat_name),
+            )
+            # only fix when the callee text sits on the call's first line
+            line_text = model.snippet(node.lineno)
+            if callee + "(" not in line_text:
+                fix = None
+            findings.append(Finding(
+                model.rel_path, node.lineno, RULE_ID, _grade(entry, version),
+                f"`{callee}` is {_version_clause(entry, version)}: "
+                f"{entry.note}; call `{entry.compat_name}` from "
+                f"runtime/jax_compat.py instead (machine-fixable: --fix)",
+                line_text, fix=fix))
+        elif isinstance(node, ast.Import):
+            # `import jax.experimental.shard_map [as x]`
+            for alias in node.names:
+                entry = LEGACY_IMPORT_MODULES.get(alias.name)
+                if entry is None:
+                    continue
+                findings.append(Finding(
+                    model.rel_path, node.lineno, RULE_ID,
+                    _grade(entry, version),
+                    f"import of `{alias.name}` is "
+                    f"{_version_clause(entry, version)}: {entry.note}; "
+                    f"import from runtime/jax_compat.py instead",
+                    model.snippet(node.lineno)))
+        elif isinstance(node, ast.ImportFrom):
+            entry = LEGACY_IMPORT_MODULES.get(node.module or "")
+            if entry is None:
+                # `from jax.experimental import shard_map [as x]`
+                for alias in node.names:
+                    full = f"{node.module}.{alias.name}" if node.module \
+                        else alias.name
+                    sub_entry = LEGACY_IMPORT_MODULES.get(full)
+                    if sub_entry is None:
+                        continue
+                    findings.append(Finding(
+                        model.rel_path, node.lineno, RULE_ID,
+                        _grade(sub_entry, version),
+                        f"import of `{full}` is "
+                        f"{_version_clause(sub_entry, version)}: "
+                        f"{sub_entry.note}; import from "
+                        f"runtime/jax_compat.py instead",
+                        model.snippet(node.lineno)))
+                continue
+            fix = None
+            names = [a.name for a in node.names]
+            aliased = [a for a in node.names if a.asname]
+            line_text = model.snippet(node.lineno)
+            legacy_import = "from jax.experimental.shard_map import shard_map"
+            if names == ["shard_map"] and not aliased \
+                    and legacy_import in line_text:
+                fix = Fix(edits=(Edit(
+                    node.lineno, legacy_import,
+                    f"from {compat_mod} import shard_map"),))
+            findings.append(Finding(
+                model.rel_path, node.lineno, RULE_ID, _grade(entry, version),
+                f"import from `{node.module}` is "
+                f"{_version_clause(entry, version)}: {entry.note}; import "
+                f"from runtime/jax_compat.py instead"
+                + (" (machine-fixable: --fix)" if fix else ""),
+                line_text, fix=fix))
+    return findings

@@ -1,0 +1,211 @@
+"""G010 unreduced-output-escapes-shard_map: per-shard value declared
+replicated.
+
+``out_specs=P()`` promises XLA the body's output is identical on every
+device. Returning a per-shard value there — a sharded input passed
+through, or any output of a body that performs no cross-device reduction
+at all — hands each consumer device whichever shard it happens to hold:
+under the legacy ``check_rep=False`` shim (and ``check_vma=False`` sites)
+nothing catches it and the training result silently depends on device
+count. This is the checker's static analog for exactly the sites where
+the runtime checker is off.
+
+Two provable patterns are flagged, both interprocedural-resolution
+gated (see program.py), anything unresolvable is trusted:
+
+- a return element at a ``P()`` position is a body *parameter* whose
+  matching ``in_specs`` entry shards an axis (direct passthrough);
+- the body and every transitively resolvable callee contain **no**
+  reducing collective (psum/pmean/pmax/pmin/all_gather/psum_scatter) yet
+  an output position is declared replicated — claimed only when the
+  returned value at that position provably *derives from a sharded
+  input* (local-assignment taint) and every call edge resolved, so a
+  single opaque helper — or an output computed purely from replicated
+  inputs — suppresses the claim. Method calls on *local values*
+  (``st.replace(...)``, ``x.sum()``) are assumed collective-free — the
+  deliberate trade-off that keeps the rule usable on idiomatic pytree
+  code; module-attribute calls are treated as opaque.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from ..findings import Finding, Severity
+from ..modmodel import dotted_name, walk_scope
+from ..program import ProgramModel
+
+RULE_ID = "G010"
+
+_REDUCING = ("psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter")
+_BENIGN_ROOTS = ("jax", "jnp", "np", "numpy", "math", "functools")
+_BENIGN_BARE = {"len", "range", "tuple", "list", "dict", "zip", "enumerate",
+                "sorted", "min", "max", "sum", "abs", "float", "int", "bool",
+                "isinstance", "getattr", "print", "P", "PartitionSpec",
+                "partial"}
+
+
+def _spec_elements(expr: Optional[ast.expr]) -> Optional[List[ast.expr]]:
+    """out_specs/in_specs as a positional list; None when not literal."""
+    if expr is None:
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return list(expr.elts)
+    return [expr]
+
+
+def _is_replicated_spec(expr: ast.expr) -> bool:
+    return isinstance(expr, ast.Call) and not expr.args \
+        and not expr.keywords \
+        and (dotted_name(expr.func) or "").rsplit(".", 1)[-1] \
+        in ("P", "PartitionSpec")
+
+
+def _is_sharded_spec(expr: ast.expr) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    if (dotted_name(expr.func) or "").rsplit(".", 1)[-1] \
+            not in ("P", "PartitionSpec"):
+        return False
+    for arg in expr.args:
+        if not (isinstance(arg, ast.Constant) and arg.value is None):
+            return True
+    return False
+
+
+def _returns(fn: ast.AST) -> List[ast.Return]:
+    return [n for n in walk_scope(fn)
+            if isinstance(n, ast.Return) and n.value is not None]
+
+
+def _sharded_taint(fn: ast.AST, sharded_params: Set[str]) -> Set[str]:
+    """Names (transitively, through local assignments) derived from the
+    sharded parameters — two passes so loop-carried taint converges."""
+    tainted = set(sharded_params)
+    for _ in range(2):
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Assign):
+                if any(isinstance(n, ast.Name) and n.id in tainted
+                       for n in ast.walk(node.value)):
+                    for tgt in node.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+    return tainted
+
+
+def _reduction_scan(program: ProgramModel, path: str, fn: ast.AST,
+                    env) -> Tuple[bool, bool]:
+    """(found_reduction, fully_resolved) over fn's transitive call graph."""
+    found = False
+    resolved = True
+    for f_path, f_fn, summ, f_env in program.walk_calls(path, fn, env):
+        for _, tail, _, _ in summ.collectives:
+            if tail in _REDUCING:
+                found = True
+        for call, callee in summ.calls:
+            root = callee.split(".", 1)[0]
+            if "." in callee:
+                if root in _BENIGN_ROOTS:
+                    continue
+                if program.imports(f_path).get(root) is None:
+                    continue  # method call on a local value: benign
+                # module-attribute call (internal or external import):
+                # not walked, so it could reduce — suppress the claim
+                resolved = False
+                continue
+            if callee in _BENIGN_BARE:
+                continue
+            bound = f_env.get(callee)
+            if bound is not None and bound[0] == "fn":
+                continue  # walked via walk_calls
+            if program.resolve_fn(f_path, callee, call) is None:
+                resolved = False
+    return found, resolved
+
+
+def check_program(program: ProgramModel, scanned: Set[str]
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    for site in program.shard_map_sites():
+        out_specs = _spec_elements(site.out_specs_expr)
+        if out_specs is None:
+            continue
+        replicated = [i for i, s in enumerate(out_specs)
+                      if _is_replicated_spec(s)]
+        if not replicated:
+            continue
+        body = program.resolve_callable(site.module, site.fn_expr)
+        if body is None:
+            continue
+        b_path, b_fn, b_env = body
+        if b_path not in scanned and site.module not in scanned:
+            continue
+        model = program.modules[b_path]
+        in_specs = _spec_elements(site.in_specs_expr)
+        params = [a.arg for a in b_fn.args.posonlyargs + b_fn.args.args]
+        sharded_params = set()
+        if in_specs is not None and len(in_specs) == len(params):
+            sharded_params = {p for p, s in zip(params, in_specs)
+                              if _is_sharded_spec(s)}
+
+        flagged_passthrough = False
+        for ret in _returns(b_fn):
+            elts = ret.value.elts if isinstance(ret.value, ast.Tuple) \
+                else [ret.value]
+            if len(elts) != len(out_specs):
+                continue
+            for i in replicated:
+                e = elts[i]
+                if isinstance(e, ast.Name) and e.id in sharded_params \
+                        and b_path in scanned:
+                    flagged_passthrough = True
+                    findings.append(Finding(
+                        b_path, ret.lineno, RULE_ID, Severity.ERROR,
+                        f"per-shard input `{e.id}` (sharded by in_specs) "
+                        f"returned at out_specs position {i} declared "
+                        f"replicated (P()) by the shard_map at "
+                        f"{site.module}:{site.call.lineno} — each consumer "
+                        f"device sees a different shard",
+                        model.snippet(ret.lineno)))
+        if flagged_passthrough:
+            continue
+        if not sharded_params:
+            # no provably-sharded input: a collective-free body may be
+            # legitimately replicated (all-P() inputs), so no claim
+            continue
+
+        # the no-reduction claim also needs data flow: the value at the
+        # replicated position must actually DERIVE from a sharded input
+        # (a replicated output computed purely from replicated inputs is
+        # legitimately identical on every device)
+        tainted = _sharded_taint(b_fn, sharded_params)
+        tainted_return = None
+        for ret in _returns(b_fn):
+            elts = ret.value.elts if isinstance(ret.value, ast.Tuple) \
+                else [ret.value]
+            if len(elts) != len(out_specs):
+                continue
+            for i in replicated:
+                if any(isinstance(n, ast.Name) and n.id in tainted
+                       for n in ast.walk(elts[i])):
+                    tainted_return = ret
+                    break
+            if tainted_return is not None:
+                break
+        if tainted_return is None:
+            continue
+
+        found, resolved = _reduction_scan(program, b_path, b_fn, b_env)
+        if not found and resolved and b_path in scanned:
+            line = tainted_return.lineno
+            findings.append(Finding(
+                b_path, line, RULE_ID, Severity.ERROR,
+                f"shard_map body `{getattr(b_fn, 'name', '<fn>')}` declares "
+                f"a replicated output (out_specs P() at the site "
+                f"{site.module}:{site.call.lineno}) but performs no "
+                f"cross-device reduction anywhere in its call graph — the "
+                f"'replicated' value is whatever shard each device computed",
+                model.snippet(line)))
+    return findings

@@ -1,0 +1,154 @@
+"""G011 collective-under-divergent-control-flow: a psum only some devices
+reach.
+
+Collectives are rendezvous points: every device in the mesh must execute
+the same collective in the same order. A collective guarded by control
+flow that can *diverge across devices* — a Python ``if`` on
+``jax.lax.axis_index`` (each device sees a different value at trace time
+under shard_map, and the branch bakes device-dependent programs), or a
+collective inside a ``jax.lax.cond``/``switch`` branch whose predicate is
+per-shard data — deadlocks on hardware or returns garbage, and does so
+only at scale, never in single-device tests.
+
+Flagged patterns:
+
+- a collective lexically inside an ``if``/``while`` whose test involves
+  ``axis_index`` (directly or through a local name bound to it);
+- a collective inside a function passed as a *branch* to
+  ``jax.lax.cond``/``jax.lax.switch`` (resolved through the program call
+  graph, so a psum two helpers below the branch is still found). Branches
+  must be collective-free regardless of the predicate: under vma
+  semantics both branches trace, but the hardware schedule only
+  rendezvous when *every* device takes the same path, which a per-shard
+  predicate cannot guarantee.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .. import config
+from ..findings import Finding, Severity
+from ..modmodel import _FN_TYPES, dotted_name, walk_scope
+from ..program import ProgramModel
+
+RULE_ID = "G011"
+
+_BRANCH_TRANSFORMS = ("cond", "switch")
+
+
+def _axis_index_names(fn: ast.AST) -> Set[str]:
+    """Local names bound to jax.lax.axis_index(...) results."""
+    names: Set[str] = set()
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func) or ""
+            if callee.rsplit(".", 1)[-1] == "axis_index":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+    return names
+
+
+def _test_is_device_varying(test: ast.expr, idx_names: Set[str]) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            if callee.rsplit(".", 1)[-1] == "axis_index":
+                return True
+        if isinstance(node, ast.Name) and node.id in idx_names:
+            return True
+    return False
+
+
+def _collectives_under(stmt_body, model) -> List[ast.Call]:
+    out = []
+    # scope-pruned walk: a def/lambda nested under the branch is a
+    # separate trace scope — it only diverges if *called* there, which the
+    # call-site analysis covers
+    stack = list(stmt_body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FN_TYPES + (ast.Lambda,)):
+            continue
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            tail = callee.rsplit(".", 1)[-1]
+            if tail in config.COLLECTIVE_CALLS and tail != "axis_index":
+                out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def check_program(program: ProgramModel, scanned: Set[str]
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+
+    def flag(path: str, call: ast.Call, why: str) -> None:
+        if path not in scanned:
+            return
+        key = (path, call.lineno, why)
+        if key in seen:
+            return
+        seen.add(key)
+        model = program.modules[path]
+        tail = (dotted_name(call.func) or "").rsplit(".", 1)[-1]
+        findings.append(Finding(
+            path, call.lineno, RULE_ID, Severity.ERROR,
+            f"collective `{tail}` under device-divergent control flow "
+            f"({why}) — collectives are rendezvous points; devices that "
+            f"skip the branch deadlock the mesh (or corrupt the reduction) "
+            f"at run time", model.snippet(call.lineno)))
+
+    for path in scanned:
+        model = program.modules.get(path)
+        if model is None:
+            continue
+        # pattern 1: if/while on axis_index around a collective
+        for fn in model.functions:
+            idx_names = _axis_index_names(fn)
+            for node in walk_scope(fn):
+                if isinstance(node, (ast.If, ast.While)) \
+                        and _test_is_device_varying(node.test, idx_names):
+                    for call in _collectives_under(node.body + node.orelse,
+                                                   model):
+                        flag(path, call,
+                             "a Python `if`/`while` on jax.lax.axis_index")
+
+    # pattern 2: collectives reachable from lax.cond/switch branches
+    for path, model in program.modules.items():
+        if "cond" not in model.source and "switch" not in model.source:
+            continue  # cheap pre-filter before the full AST walk
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func) or ""
+            tail = callee.rsplit(".", 1)[-1]
+            if tail not in _BRANCH_TRANSFORMS or not callee.startswith(
+                    ("jax.lax.", "lax.")):
+                continue
+            if tail == "cond":
+                branches = node.args[1:3]
+            else:
+                # switch(index, branches_sequence, *operands): only a
+                # literal branch list resolves; operands are data, never
+                # branches
+                seq = node.args[1] if len(node.args) > 1 else None
+                branches = list(seq.elts) \
+                    if isinstance(seq, (ast.Tuple, ast.List)) else []
+            for br in branches:
+                body = program.resolve_callable(path, br)
+                if body is None:
+                    continue
+                b_path, b_fn, b_env = body
+                for f_path, f_fn, summ, _ in program.walk_calls(
+                        b_path, b_fn, b_env):
+                    for call, c_tail, _, _ in summ.collectives:
+                        if c_tail == "axis_index":
+                            continue
+                        flag(f_path, call,
+                             f"a `jax.lax.{tail}` branch at "
+                             f"{path}:{node.lineno}")
+    return findings

@@ -1,8 +1,16 @@
 """File walking + rule dispatch + diagnostics formatting.
 
-Stdlib-only and jax-free by design: a full-tree scan must stay well under
-the 5s budget of scripts/lint.sh, and graftcheck must be runnable on hosts
+Stdlib-only and jax-free by design: a full-tree scan must stay in the ~5s
+budget of scripts/lint.sh, and graftcheck must be runnable on hosts
 without an accelerator stack.
+
+Two rule tiers run over every scan:
+
+- **module rules** (G001–G006, G009) see one ModuleModel at a time;
+- **program rules** (G007/G008/G010/G011) see the whole-program model
+  (program.py), which is always built with the full package tree as
+  context — a single-file scan resolves cross-module call edges exactly
+  like a full scan, but only *emits* findings for the scanned files.
 """
 
 from __future__ import annotations
@@ -10,11 +18,12 @@ from __future__ import annotations
 import ast
 import os
 from pathlib import Path
-from typing import Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from .findings import (Finding, Severity, apply_suppressions,
                        parse_suppressions, sort_findings)
 from .modmodel import ModuleModel
+from .program import ProgramModel
 
 _SKIP_DIRS = {"__pycache__", ".git", ".mypy_cache", ".pytest_cache"}
 
@@ -46,39 +55,102 @@ def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
             yield p
 
 
+def _run_rules(models: Dict[str, ModuleModel],
+               parse_failures: List[Finding],
+               sources: Dict[str, str],
+               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Module rules per model + program rules over the whole set, then
+    per-file suppressions."""
+    from .rules import ALL_RULES, PROGRAM_RULES
+
+    findings: List[Finding] = list(parse_failures)
+    for rel_path, model in models.items():
+        for rule_id, check in ALL_RULES.items():
+            if rules is not None and rule_id not in rules:
+                continue
+            findings.extend(check(model))
+    selected_program_rules = [
+        (rule_id, check_program)
+        for rule_id, check_program in PROGRAM_RULES.items()
+        if rules is None or rule_id in rules]
+    if selected_program_rules:  # skip the package parse when filtered out
+        program = ProgramModel(models)
+        scanned = set(models)
+        for rule_id, check_program in selected_program_rules:
+            findings.extend(f for f in check_program(program, scanned)
+                            if f.path in scanned)
+    out: List[Finding] = []
+    suppressions = {p: parse_suppressions(src) for p, src in sources.items()}
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    for p, flist in by_path.items():
+        if p in suppressions:
+            per_line, whole_file = suppressions[p]
+            out.extend(apply_suppressions(flist, per_line, whole_file))
+        else:
+            out.extend(flist)
+    return sort_findings(out)
+
+
 def analyze_source(source: str, rel_path: str,
                    rules: Optional[Sequence[str]] = None) -> List[Finding]:
     """Run graftcheck over one module's source. `rel_path` is the
     normalized path used for scope decisions (hot modules, dtype modules)
     and reporting."""
-    from .rules import ALL_RULES
-
     try:
         model = ModuleModel(rel_path, source, ast.parse(source,
                                                         filename=rel_path))
     except SyntaxError as e:
         return [Finding(rel_path, e.lineno or 0, "G000", Severity.ERROR,
                         f"syntax error: {e.msg}", "")]
-    findings: List[Finding] = []
-    for rule_id, check in ALL_RULES.items():
-        if rules is not None and rule_id not in rules:
-            continue
-        findings.extend(check(model))
-    per_line, whole_file = parse_suppressions(source)
-    return sort_findings(apply_suppressions(findings, per_line, whole_file))
+    return _run_rules({rel_path: model}, [], {rel_path: source}, rules)
 
 
 def analyze_paths(paths: Sequence[str],
                   rules: Optional[Sequence[str]] = None) -> List[Finding]:
-    findings: List[Finding] = []
+    models: Dict[str, ModuleModel] = {}
+    sources: Dict[str, str] = {}
+    parse_failures: List[Finding] = []
     for path in iter_python_files(paths):
+        rel = normalize_path(path)
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 source = fh.read()
         except OSError as e:
-            findings.append(Finding(normalize_path(path), 0, "G000",
-                                    Severity.ERROR, f"unreadable: {e}", ""))
+            parse_failures.append(Finding(rel, 0, "G000", Severity.ERROR,
+                                          f"unreadable: {e}", ""))
             continue
-        findings.extend(analyze_source(source, normalize_path(path),
-                                       rules=rules))
-    return sort_findings(findings)
+        try:
+            models[rel] = ModuleModel(rel, source,
+                                      ast.parse(source, filename=rel))
+            sources[rel] = source
+        except SyntaxError as e:
+            parse_failures.append(Finding(rel, e.lineno or 0, "G000",
+                                          Severity.ERROR,
+                                          f"syntax error: {e.msg}", ""))
+    return _run_rules(models, parse_failures, sources, rules)
+
+
+def expand_to_callers(paths: Sequence[str]) -> List[str]:
+    """The scanned set plus every package module that (transitively)
+    imports one of the scanned modules — interprocedural rules can fire in
+    an unchanged caller when its callee changed, so changed-files scans
+    must include the callers. Returns filesystem paths; non-package inputs
+    pass through untouched."""
+    file_list = list(iter_python_files(paths))
+    rel_of = {normalize_path(p): p for p in file_list}
+    program = ProgramModel({}, with_package_context=True)
+    targets = {r for r in rel_of if r in program.modules}
+    if not targets:
+        return file_list
+    from .program import package_root
+    root = os.path.dirname(package_root())
+    extra = []
+    for rel in sorted(program.importers_of(targets)):
+        if rel in rel_of:
+            continue
+        fs = os.path.join(root, *rel.split("/"))
+        if os.path.exists(fs):
+            extra.append(fs)
+    return file_list + extra

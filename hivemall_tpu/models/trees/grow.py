@@ -23,6 +23,7 @@ from typing import List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from ...runtime.jax_compat import shard_map
 
 NEG = -1e30
 
@@ -200,8 +201,8 @@ def _sharded_hist_fn(kind: str, mesh, axis: str, S: int, B: int, C: int):
                     P(None, axis))
     else:
         raise ValueError(f"unknown sharded-hist kind {kind!r}")
-    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                                 out_specs=P()))
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=P()))
 
 
 def _pad_rows(arrs, Xb, n_dev: int):

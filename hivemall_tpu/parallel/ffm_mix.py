@@ -24,6 +24,7 @@ from jax.sharding import PartitionSpec as P
 from ..models.ffm import FFMHyper, FFMState, init_ffm_state, make_ffm_step
 from .mesh import WORKER_AXIS, make_mesh
 from .mix import MixConfig, grouped_mix_scan, replicate_state
+from ..runtime.jax_compat import pcast, shard_map
 
 
 class FFMMixTrainer:
@@ -53,7 +54,7 @@ class FFMMixTrainer:
             # again.
             # pcast re-tags device-invariant pmean results as mesh-varying so
             # the grouped-scan carry type stays consistent
-            revary = lambda x: jax.lax.pcast(x, self.axis, to="varying")
+            revary = lambda x: pcast(x, self.axis, to="varying")
             return st.replace(
                 w=touch_avg(st.w),
                 z=touch_avg(st.z),
@@ -79,7 +80,7 @@ class FFMMixTrainer:
         spec_state = jax.tree.map(lambda _: P(self.axis),
                                   jax.eval_shape(lambda: init_ffm_state(hyper)))
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 device_step,
                 mesh=self.mesh,
                 in_specs=(spec_state,) + (P(self.axis),) * 4,

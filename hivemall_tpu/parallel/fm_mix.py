@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..models.fm import FMHyper, FMState, init_fm_state, make_fm_step
 from .mesh import WORKER_AXIS, make_mesh
 from .mix import MixConfig, grouped_mix_scan, replicate_state
+from ..runtime.jax_compat import pcast, shard_map
 
 
 class FMMixTrainer:
@@ -63,7 +64,7 @@ class FMMixTrainer:
                           / jnp.maximum(total, 1.0)[:, None], st.v)
             # pcast re-tags the device-invariant pmean result as mesh-varying
             # so the grouped-scan carry type stays consistent
-            w0 = jax.lax.pcast(jax.lax.pmean(st.w0, self.axis), self.axis, to="varying")
+            w0 = pcast(jax.lax.pmean(st.w0, self.axis), self.axis, to="varying")
             return st.replace(w=w, v=v, w0=w0)
 
         def device_step(state: FMState, indices, values, labels, va):
@@ -82,7 +83,7 @@ class FMMixTrainer:
         spec_state = jax.tree.map(lambda _: P(self.axis),
                                   jax.eval_shape(lambda: init_fm_state(dims, hyper)))
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 device_step,
                 mesh=self.mesh,
                 in_specs=(spec_state, P(self.axis), P(self.axis), P(self.axis),

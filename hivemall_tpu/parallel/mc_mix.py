@@ -23,6 +23,7 @@ from ..models.multiclass import (MCRule, MulticlassState, make_mc_train_step)
 from .mesh import WORKER_AXIS, make_mesh
 from .mix import (MixConfig, grouped_mix_scan, merge_slot_arrays,
                   replicate_state)
+from ..runtime.jax_compat import shard_map
 
 
 class MulticlassMixTrainer:
@@ -84,7 +85,7 @@ class MulticlassMixTrainer:
         self._init_one = init_one
         spec_state = jax.tree.map(lambda _: P(self.axis), jax.eval_shape(init_one))
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 device_step,
                 mesh=self.mesh,
                 in_specs=(spec_state, P(self.axis), P(self.axis), P(self.axis)),

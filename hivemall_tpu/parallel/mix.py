@@ -44,6 +44,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.engine import DELTA_SLOT, Rule, make_train_fn
 from ..core.state import LinearState, init_linear_state
 from .mesh import WORKER_AXIS, make_mesh
+from ..runtime.jax_compat import shard_map
 
 
 def mix_average(weights, delta_upd, axis_name: str = WORKER_AXIS):
@@ -361,7 +362,7 @@ class MixTrainer:
         spec_state = jax.tree.map(lambda _: P(self.config.axis_name),
                                   jax.eval_shape(self._init_abstract))
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 device_step,
                 mesh=self.mesh,
                 in_specs=(spec_state, P(axis), P(axis), P(axis)),

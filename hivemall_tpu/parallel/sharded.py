@@ -19,6 +19,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from .mesh import WORKER_AXIS
+from ..runtime.jax_compat import shard_map
 
 
 def shard_weights(weights, mesh: Mesh, axis_name: str = WORKER_AXIS):
@@ -54,7 +55,7 @@ def make_sharded_predict(mesh: Mesh, dims: int, axis_name: str = WORKER_AXIS):
     if shard * n != dims:
         raise ValueError(f"dims {dims} not divisible by {n} devices")
 
-    fn = jax.shard_map(
+    fn = shard_map(
         stripe_score(axis_name, shard),
         mesh=mesh,
         in_specs=(P(axis_name), P(), P()),

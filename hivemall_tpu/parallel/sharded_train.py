@@ -56,6 +56,7 @@ from .mesh import SHARD_AXIS, WORKER_AXIS, make_mesh, make_mesh_2d
 from .mix import (MixConfig, collapse_linear_replicas, grouped_mix_scan,
                   make_linear_mix, replicate_state, split_replica_blocks)
 from .sharded import stripe_score
+from ..runtime.jax_compat import shard_map
 
 
 def _resolve_1d_mesh(mesh: Optional[Mesh], who: str):
@@ -144,7 +145,7 @@ class ShardedTrainer:
             lambda leaf: P(self.axis) if leaf.ndim == 1 else P(), state_shape)
         self._specs = specs
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body_fn,
                 mesh=self.mesh,
                 in_specs=(specs, P(), P(), P()),
@@ -196,7 +197,7 @@ class ShardedTrainer:
         same mesh, same stripe placement, same stripe_score body as
         parallel/sharded.make_sharded_predict, so a model trained sharded
         serves sharded with no re-placement step."""
-        fn = jax.shard_map(
+        fn = shard_map(
             stripe_score(self.axis, self.stripe),
             mesh=self.mesh,
             in_specs=(P(self.axis), P(), P()),
@@ -241,7 +242,7 @@ class FMShardedTrainer:
             if leaf.ndim >= 1 and leaf.shape[0] == dp else P(), state_shape)
         self._specs = specs
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(specs, P(), P(), P(), P()),
@@ -280,7 +281,7 @@ class FMShardedTrainer:
                 w, v, w0, idx, val, axis, stripe)
             return p
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local_scores,
             mesh=self.mesh,
             in_specs=(P(self.axis), P(self.axis, None), P(), P(), P()),
@@ -348,7 +349,7 @@ class FFMShardedTrainer:
             state_shape)
         self._specs = specs
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(specs, P(), P(), P(), P()),
@@ -397,7 +398,7 @@ class FFMShardedTrainer:
 
             return jax.vmap(one)(idx, val, fld)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local_scores,
             mesh=self.mesh,
             in_specs=(self._specs, P(), P(), P()),
@@ -475,7 +476,7 @@ class MCShardedTrainer:
             if leaf.ndim == 2 and leaf.shape[-1] == dp else P(), state_shape)
         self._specs = specs
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 body,
                 mesh=self.mesh,
                 in_specs=(specs, P(), P(), P()),
@@ -510,7 +511,7 @@ class MCShardedTrainer:
                          fill_value=0.0)  # [L, B, K]
             return jax.lax.psum(jnp.einsum("lbk,bk->bl", W, vmask), axis)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local_scores,
             mesh=self.mesh,
             in_specs=(P(None, self.axis), P(), P()),
@@ -602,7 +603,7 @@ class Sharded2DTrainer:
         self._specs = specs
         blk = P(self.replica_axis)
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 device_step,
                 mesh=self.mesh,
                 in_specs=(specs, blk, blk, blk),
@@ -663,7 +664,7 @@ class Sharded2DTrainer:
             return stripe_score(self.shard_axis, self.stripe)(
                 w_local[0], indices, values)
 
-        fn = jax.shard_map(
+        fn = shard_map(
             local_score,
             mesh=self.mesh,
             in_specs=(P(self.replica_axis, self.shard_axis), P(), P()),

@@ -61,7 +61,11 @@ class LRUMap(OrderedDict):
         if key in self:
             super().__delitem__(key)
         elif len(self) >= self.capacity:
-            self.popitem(last=False)
+            # not popitem(): the C implementation re-enters the overridden
+            # __getitem__ after unlinking the node, and its move_to_end
+            # then KeyErrors on the half-removed key
+            oldest = next(iter(self))
+            super().__delitem__(oldest)
         super().__setitem__(key, value)
 
     def __getitem__(self, key):

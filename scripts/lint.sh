@@ -1,24 +1,50 @@
 #!/usr/bin/env bash
 # graftcheck gate (hivemall_tpu/analysis): JAX/TPU-aware static analysis.
 #
-#   scripts/lint.sh            # changed-files mode (<5s): files touched vs
-#                              # HEAD (staged + unstaged + untracked)
-#   scripts/lint.sh --all      # full-tree scan of hivemall_tpu/
-#   scripts/lint.sh FILES...   # explicit file list
+#   scripts/lint.sh              # changed-files mode (~5s): files touched vs
+#                                # HEAD (staged + unstaged + untracked), PLUS
+#                                # the modules that import them — the
+#                                # interprocedural rules (G007-G011) can fire
+#                                # in an unchanged caller whose callee changed
+#   scripts/lint.sh --all        # full-tree scan of hivemall_tpu/
+#   scripts/lint.sh --fix-check  # fail if `--fix` would diff the changed
+#                                # files; combine with --all for full-tree
+#   scripts/lint.sh FILES...     # explicit file list
 #
 # Exits non-zero on any finding not covered by analysis/baseline.json.
 # Accepted debt is refreshed with:
 #   python -m hivemall_tpu.analysis --update-baseline
+# Machine-applicable findings (G009) are repaired with:
+#   python -m hivemall_tpu.analysis --fix
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-if [[ "${1:-}" == "--all" ]]; then
-  exec python -m hivemall_tpu.analysis hivemall_tpu/
+# leading flags parse order-independently: --fix-check --all == --all --fix-check
+mode_args=()
+all=0
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --fix-check) mode_args=(--fix-check); shift ;;
+    --all) all=1; shift ;;
+    *) break ;;
+  esac
+done
+
+if [[ $all -eq 1 ]]; then
+  exec python -m hivemall_tpu.analysis hivemall_tpu/ ${mode_args[@]+"${mode_args[@]}"}
 elif [[ $# -gt 0 ]]; then
-  exec python -m hivemall_tpu.analysis "$@"
+  exec python -m hivemall_tpu.analysis "$@" ${mode_args[@]+"${mode_args[@]}"}
 fi
 
-# changed-files mode: python files under hivemall_tpu/ touched since HEAD
+# changed-files mode needs git; outside a work tree (tarball checkouts, CI
+# images without .git) fall back to the full-tree scan rather than silently
+# checking nothing
+if ! git rev-parse --is-inside-work-tree >/dev/null 2>&1; then
+  echo "graftcheck: git diff unavailable — falling back to full-tree scan"
+  exec python -m hivemall_tpu.analysis hivemall_tpu/ ${mode_args[@]+"${mode_args[@]}"}
+fi
+
+# python files under hivemall_tpu/ touched since HEAD
 # (portable read loop — macOS stock bash 3.2 has no mapfile builtin)
 existing=()
 while IFS= read -r f; do
@@ -35,4 +61,7 @@ if [[ ${#existing[@]} -eq 0 ]]; then
   echo "graftcheck: no changed python files under hivemall_tpu/"
   exit 0
 fi
-exec python -m hivemall_tpu.analysis "${existing[@]}"
+# --with-callers widens the scan to modules importing the changed ones, so
+# interprocedural findings surfacing in unchanged callers are still caught
+exec python -m hivemall_tpu.analysis --with-callers "${existing[@]}" \
+  ${mode_args[@]+"${mode_args[@]}"}

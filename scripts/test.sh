@@ -7,9 +7,17 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-# tier-1 gate 1: graftcheck static analysis on changed files (<5s) — any
-# new non-baselined recompile/host-sync/dtype/axis/donation/side-effect
-# finding fails before pytest spends minutes (docs/static_analysis.md)
+# tier-1 gate 1: graftcheck static analysis on changed files (+ their
+# callers) — any new non-baselined recompile/host-sync/dtype/axis/donation/
+# side-effect/SPMD-safety finding fails before pytest spends minutes
+# (docs/static_analysis.md)
 bash scripts/lint.sh
+
+# tier-1 gate 2: no machine-applicable fix may be left unapplied in the
+# changed files — if `--fix` would produce a diff there, fail with the
+# would-be diff so the fix lands in the same change (full-tree fix
+# cleanliness is locked by the baseline test: a fixable finding is always
+# a non-baselined finding)
+bash scripts/lint.sh --fix-check
 
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

@@ -1,0 +1,48 @@
+"""G007 negative fixture: bound axes, dynamic axes (trusted), unknown
+meshes (trusted) — zero findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from hivemall_tpu.runtime.jax_compat import shard_map
+
+WORKER_AXIS = "workers"
+SHARD_AXIS = "shards"
+
+
+def helper_loss(x):
+    return jax.lax.psum(jnp.sum(x), WORKER_AXIS)
+
+
+def body(x):
+    return helper_loss(x * 2)
+
+
+def make_step():
+    # the axis the helper reduces over IS bound by this mesh
+    mesh = Mesh(np.asarray(jax.devices()), (WORKER_AXIS,))
+    return shard_map(body, mesh=mesh, in_specs=P(WORKER_AXIS), out_specs=P())
+
+
+def mix_avg(w, axis_name):
+    # dynamic axis parameter with no resolvable binding: trusted
+    return jax.lax.pmean(w, axis_name)
+
+
+def make_step_2d(axis_for_mix):
+    mesh = Mesh(np.asarray(jax.devices()).reshape(-1, 1),
+                (WORKER_AXIS, SHARD_AXIS))
+
+    def body2(w):
+        return mix_avg(w, axis_for_mix)
+
+    return shard_map(body2, mesh=mesh, in_specs=P(WORKER_AXIS),
+                     out_specs=P())
+
+
+def make_step_unknown_mesh(mesh):
+    # the mesh expression does not resolve: trusted
+    return shard_map(body, mesh=mesh, in_specs=P(WORKER_AXIS), out_specs=P())

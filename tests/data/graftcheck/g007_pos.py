@@ -1,0 +1,43 @@
+"""G007 positive fixture: collective axes not bound by the enclosing
+shard_map — including through helper calls (the interprocedural case)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from hivemall_tpu.runtime.jax_compat import shard_map
+
+WORKER_AXIS = "workers"
+SHARD_AXIS = "shards"
+
+
+def helper_loss(x):
+    # two call-graph levels below the shard_map site: still checked
+    return jax.lax.psum(jnp.sum(x), WORKER_AXIS)  # EXPECT: G007
+
+
+def body(x):
+    local = x * 2
+    return helper_loss(local)
+
+
+def make_step():
+    # the mesh only binds "shards"; the helper psums over "workers"
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(body, mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P())
+
+
+def mix_avg(w, axis_name=WORKER_AXIS):
+    return jax.lax.pmean(w, axis_name)  # EXPECT: G007
+
+
+def body2(w):
+    # the literal argument propagates along the call edge
+    return mix_avg(w, WORKER_AXIS)
+
+
+def make_step2():
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(body2, mesh=mesh, in_specs=P(SHARD_AXIS), out_specs=P())

@@ -1,0 +1,47 @@
+"""G008 negative fixture: specs consistent with their mesh; dynamic specs
+and unknown meshes trusted — zero findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from hivemall_tpu.runtime.jax_compat import shard_map
+
+WORKER_AXIS = "workers"
+SHARD_AXIS = "shards"
+
+
+def local_score(w, x):
+    return jax.lax.psum(jnp.sum(w * x), SHARD_AXIS)
+
+
+def make_predict():
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(local_score, mesh=mesh,
+                     in_specs=(P(SHARD_AXIS), P()), out_specs=P())
+
+
+def make_predict_2d():
+    mesh = Mesh(np.asarray(jax.devices()).reshape(-1, 1),
+                (WORKER_AXIS, SHARD_AXIS))
+    return shard_map(local_score, mesh=mesh,
+                     in_specs=(P(WORKER_AXIS, SHARD_AXIS), P()),
+                     out_specs=P())
+
+
+def place(x):
+    mesh = Mesh(np.asarray(jax.devices()), (WORKER_AXIS,))
+    return jax.device_put(x, NamedSharding(mesh, P(WORKER_AXIS)))
+
+
+def place_dynamic(x, spec):
+    # non-literal spec: trusted
+    mesh = Mesh(np.asarray(jax.devices()), (WORKER_AXIS,))
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def place_unknown_mesh(x, mesh):
+    # unknown mesh: trusted
+    return jax.device_put(x, NamedSharding(mesh, P("model")))

@@ -1,0 +1,29 @@
+"""G008 positive fixture: PartitionSpec axes the mesh does not bind."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from hivemall_tpu.runtime.jax_compat import shard_map
+
+WORKER_AXIS = "workers"
+SHARD_AXIS = "shards"
+
+
+def local_score(w, x):
+    return jax.lax.psum(jnp.sum(w * x), SHARD_AXIS)
+
+
+def make_predict():
+    # 1-D mesh binds only "shards"; the in_spec names "workers"
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(local_score, mesh=mesh,
+                     in_specs=(P(WORKER_AXIS), P()),  # EXPECT: G008
+                     out_specs=P())
+
+
+def place(x):
+    mesh = Mesh(np.asarray(jax.devices()), (WORKER_AXIS,))
+    return jax.device_put(x, NamedSharding(mesh, P("model")))  # EXPECT: G008

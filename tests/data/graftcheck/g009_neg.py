@@ -1,0 +1,26 @@
+"""G009 negative fixture: every shard_map/pcast use goes through the
+version-portable runtime/jax_compat surface — zero findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from hivemall_tpu.runtime.jax_compat import pcast, shard_map
+
+WORKER_AXIS = "workers"
+
+
+def local_sum(x):
+    return jax.lax.psum(jnp.sum(x), WORKER_AXIS)
+
+
+def make_step():
+    mesh = Mesh(np.asarray(jax.devices()), (WORKER_AXIS,))
+    return shard_map(local_sum, mesh=mesh, in_specs=P(WORKER_AXIS),
+                     out_specs=P(), check_vma=False)
+
+
+def retag(x):
+    return pcast(x, WORKER_AXIS, to="varying")

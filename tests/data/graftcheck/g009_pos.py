@@ -1,0 +1,26 @@
+"""G009 positive fixture: version-fragile raw shard_map/pcast spellings.
+Every finding here carries a machine-applicable fix; the fixer round-trip
+test applies them and re-scans to zero."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map  # EXPECT: G009
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+WORKER_AXIS = "workers"
+
+
+def local_sum(x):
+    return jax.lax.psum(jnp.sum(x), WORKER_AXIS)
+
+
+def make_step_new_api():
+    mesh = Mesh(np.asarray(jax.devices()), (WORKER_AXIS,))
+    return jax.shard_map(  # EXPECT: G009
+        local_sum, mesh=mesh, in_specs=P(WORKER_AXIS), out_specs=P())
+
+
+def retag(x):
+    return jax.lax.pcast(x, WORKER_AXIS, to="varying")  # EXPECT: G009

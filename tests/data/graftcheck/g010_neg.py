@@ -1,0 +1,47 @@
+"""G010 negative fixture: reduced outputs, honestly-sharded outputs, and
+opaque helpers (trusted) — zero findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from external_scoring import opaque_score
+
+from hivemall_tpu.runtime.jax_compat import shard_map
+
+SHARD_AXIS = "shards"
+
+
+def reduced(w, idx):
+    s = jnp.take(w, idx, axis=0)
+    return jax.lax.psum(jnp.sum(s), SHARD_AXIS)
+
+
+def make_reduced():
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(reduced, mesh=mesh, in_specs=(P(SHARD_AXIS), P()),
+                     out_specs=P())
+
+
+def sharded_out(w, idx):
+    # per-shard output declared per-shard: fine
+    return w * 2
+
+
+def make_sharded_out():
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(sharded_out, mesh=mesh, in_specs=(P(SHARD_AXIS), P()),
+                     out_specs=P(SHARD_AXIS))
+
+
+def calls_opaque(w, idx):
+    # opaque external helper: could reduce internally, so it is trusted
+    return opaque_score(w, idx)
+
+
+def make_opaque():
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(calls_opaque, mesh=mesh, in_specs=(P(SHARD_AXIS), P()),
+                     out_specs=P())

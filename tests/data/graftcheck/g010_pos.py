@@ -1,0 +1,35 @@
+"""G010 positive fixture: per-shard values escaping shard_map at output
+positions declared replicated (out_specs P())."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from hivemall_tpu.runtime.jax_compat import shard_map
+
+SHARD_AXIS = "shards"
+
+
+def passthrough(w, idx):
+    # w is sharded by in_specs yet returned as 'replicated'
+    return w  # EXPECT: G010
+
+
+def make_bad_passthrough():
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(passthrough, mesh=mesh, in_specs=(P(SHARD_AXIS), P()),
+                     out_specs=P())
+
+
+def local_top(w, idx):
+    s = jnp.take(w, idx, axis=0)
+    # no collective anywhere in the call graph, output declared replicated
+    return jnp.sum(s)  # EXPECT: G010
+
+
+def make_bad_unreduced():
+    mesh = Mesh(np.asarray(jax.devices()), (SHARD_AXIS,))
+    return shard_map(local_top, mesh=mesh, in_specs=(P(SHARD_AXIS), P()),
+                     out_specs=P())

@@ -1,0 +1,35 @@
+"""G011 negative fixture: collectives at rendezvous-safe positions — zero
+findings."""
+
+import jax
+import jax.numpy as jnp
+
+WORKER_AXIS = "workers"
+
+
+def reduce_then_pick(x):
+    # every device executes the psum; only the USE is device-dependent
+    total = jax.lax.psum(x, WORKER_AXIS)
+    i = jax.lax.axis_index(WORKER_AXIS)
+    return jnp.where(i == 0, total, x)
+
+
+def t_branch(x):
+    return x * 2
+
+
+def f_branch(x):
+    return x
+
+
+def branch_no_collective(pred, x):
+    # branches are collective-free: divergence cannot strand a rendezvous
+    total = jax.lax.psum(x, WORKER_AXIS)
+    return jax.lax.cond(pred, t_branch, f_branch, total)
+
+
+def loop_reduce(x, steps):
+    # an ordinary Python loop bound: same trip count on every device
+    for _ in range(steps):
+        x = x + jax.lax.psum(x, WORKER_AXIS)
+    return x

@@ -1,0 +1,27 @@
+"""G011 positive fixture: collectives under device-divergent control flow."""
+
+import jax
+import jax.numpy as jnp
+
+WORKER_AXIS = "workers"
+
+
+def skewed_reduce(x):
+    i = jax.lax.axis_index(WORKER_AXIS)
+    if i == 0:
+        # only device 0 reaches the rendezvous: deadlock on hardware
+        return jax.lax.psum(x, WORKER_AXIS)  # EXPECT: G011
+    return x
+
+
+def t_branch(x):
+    return jax.lax.psum(x, WORKER_AXIS)  # EXPECT: G011
+
+
+def f_branch(x):
+    return x
+
+
+def branch_reduce(pred, x):
+    # a per-shard predicate cannot guarantee every device takes t_branch
+    return jax.lax.cond(pred, t_branch, f_branch, x)

@@ -179,6 +179,10 @@ def test_multiprocess_failure_then_elastic_restart(tmp_path):
             pytest.fail("recovery child timed out")
         logs.append(out)
 
+    if any("Multiprocess computations aren't implemented on the CPU "
+           "backend" in log for log in logs):
+        pytest.skip("installed jax cannot run cross-process collectives "
+                    "on the CPU backend")
     # failure detection: the job died non-zero AFTER checkpointing
     for pid, (p, log) in enumerate(zip(procs, logs)):
         assert p.returncode == 7, f"child {pid}: rc={p.returncode}\n{log}"

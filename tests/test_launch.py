@@ -14,6 +14,13 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = os.path.join(REPO, "tests", "_launch_child.py")
 
+# jax <= 0.4.x has no cross-process collective transport on CPU: the
+# cluster joins, then the first collective dies with this message. The
+# multi-process tests skip on it — the capability, not the version, is
+# what they need (runtime/jax_compat.py covers the API surface only).
+CPU_MP_UNSUPPORTED = \
+    "Multiprocess computations aren't implemented on the CPU backend"
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -62,13 +69,19 @@ def test_launch_two_process_cluster(tmp_path):
              CHILD, str(out)],
             env=_env(), cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
-    for pid, p in enumerate(procs):
+    logs = []
+    for p in procs:
         try:
             log, _ = p.communicate(timeout=300)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             pytest.fail("launch child timed out")
+        logs.append(log)
+    if any(CPU_MP_UNSUPPORTED in log for log in logs):
+        pytest.skip("installed jax cannot run cross-process collectives "
+                    "on the CPU backend")
+    for pid, (p, log) in enumerate(zip(procs, logs)):
         assert p.returncode == 0, f"proc {pid}:\n{log}"
         assert f"LAUNCH CHILD {pid} OK" in log
     recs = [json.loads(o.read_text()) for o in outs]

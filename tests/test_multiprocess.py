@@ -63,6 +63,10 @@ def mp_outputs(tmp_path_factory):
                 q.kill()
             pytest.fail("multi-process child timed out")
         logs.append(stdout)
+    if any("Multiprocess computations aren't implemented on the CPU "
+           "backend" in log for log in logs):
+        pytest.skip("installed jax cannot run cross-process collectives "
+                    "on the CPU backend")
     for pid, (p, log) in enumerate(zip(procs, logs)):
         assert p.returncode == 0, f"child {pid} failed:\n{log}"
         assert f"CHILD {pid} OK" in log
