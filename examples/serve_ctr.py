@@ -1,0 +1,98 @@
+"""Online CTR serving end to end: train -> freeze -> deploy -> /predict ->
+hot swap — the docs/serving.md walkthrough as a runnable script.
+
+The reference scores CTR offline (model table JOIN feature table in Hive);
+this is the online path the ROADMAP's "heavy traffic" north star needs:
+an immutable artifact per version, a warmed shape-bucketed engine, dynamic
+micro-batching, and an atomic v1 -> v2 swap under live requests.
+
+Runs CPU-only in seconds: `python examples/serve_ctr.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hivemall_tpu.models.classifier import train_arow  # noqa: E402
+from hivemall_tpu.serving import ModelRegistry, freeze, serve  # noqa: E402
+
+DIMS = 1 << 12
+
+
+def make_ctr_data(n: int, seed: int):
+    """Synthetic CTR rows: "feature:value" strings, clicky features 0-7."""
+    rng = np.random.RandomState(seed)
+    rows, labels = [], []
+    for _ in range(n):
+        k = rng.randint(3, 10)
+        feats = rng.randint(0, DIMS, k)
+        rows.append([f"{f}:1.0" for f in feats])
+        labels.append(1 if (feats < 8).any() or rng.rand() < 0.1 else -1)
+    return rows, labels
+
+
+def post_predict(port: int, payload: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def main() -> None:
+    rows, labels = make_ctr_data(400, seed=1)
+
+    # 1. train + freeze v1 as an immutable artifact
+    v1 = train_arow(rows, labels, f"-dims {DIMS}")
+    root = tempfile.mkdtemp(prefix="ctr_artifacts_")
+    freeze(v1, os.path.join(root, "1"), name="ctr", version="1")
+    print(f"frozen artifact: {os.path.join(root, '1')}")
+
+    # 2. deploy (warms every shape bucket) and serve
+    registry = ModelRegistry(max_batch=64, max_delay_ms=1.0,
+                             engine_kwargs={"max_batch": 64, "max_width": 32})
+    registry.deploy("ctr", os.path.join(root, "1"))
+    server = serve(registry)
+    port = server.server_address[1]
+    print(f"serving on 127.0.0.1:{port}  (POST /predict, GET /models, "
+          f"GET /metrics)")
+
+    # 3. score over the wire
+    out = post_predict(port, {"model": "ctr", "instances": rows[:4]})
+    print(f"v{out['version']} scores: "
+          f"{[round(p, 4) for p in out['predictions']]}")
+
+    # 4. retrain on fresh data and hot-swap — no restart, no failed requests
+    more_rows, more_labels = make_ctr_data(800, seed=2)
+    v2 = train_arow(rows + more_rows, labels + more_labels, f"-dims {DIMS}")
+    freeze(v2, os.path.join(root, "2"), name="ctr", version="2")
+    registry.deploy("ctr", os.path.join(root, "2"))
+    out = post_predict(port, {"model": "ctr", "instances": rows[:4]})
+    print(f"hot-swapped to v{out['version']}: "
+          f"{[round(p, 4) for p in out['predictions']]}")
+
+    # the zero-recompile witness: after deploy-time warmup, steady-state
+    # requests never retraced (the counter recompile_guard exports)
+    metrics = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    recompiles = [l for l in metrics.splitlines()
+                  if l.startswith("hivemall_tpu_graftcheck_recompiles_serving_ctr ")]
+    assert recompiles == ["hivemall_tpu_graftcheck_recompiles_serving_ctr 0.0"], \
+        recompiles
+    print(f"steady-state recompiles: {recompiles[0].rsplit(' ', 1)[1]}")
+    server.shutdown()
+    registry.shutdown()
+    print("train -> freeze -> deploy -> predict -> hot swap: done")
+
+
+if __name__ == "__main__":
+    main()

@@ -43,8 +43,12 @@ def save_model_rows(path: str, feats: np.ndarray, weights: np.ndarray,
 
 def load_model_rows(path: str) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
     if path.endswith(".npz"):
-        z = np.load(path)
-        return z["feature"], z["weight"], z["covar"] if "covar" in z.files else None
+        # context-manage the NpzFile: np.load keeps the zip member open
+        # until closed, and a long-lived scorer reloading models would
+        # otherwise leak one fd per reload
+        with np.load(path) as z:
+            return (z["feature"], z["weight"],
+                    z["covar"] if "covar" in z.files else None)
     if path.endswith((".tsv", ".csv", ".txt")):
         return _load_text_model_rows(path)
     with open(path, "rb") as f:
@@ -104,18 +108,20 @@ def save_linear_state(path: str, state: LinearState) -> None:
 
 
 def load_linear_state(path: str) -> LinearState:
-    z = np.load(path)
     import jax.numpy as jnp
 
-    slots = {k[len("slot__"):]: jnp.asarray(z[k]) for k in z.files
-             if k.startswith("slot__")}
-    globals_ = {k[len("global__"):]: jnp.asarray(z[k]) for k in z.files
-                if k.startswith("global__")}
-    return LinearState(
-        weights=jnp.asarray(z["weights"]),
-        covars=jnp.asarray(z["covars"]) if "covars" in z.files else None,
-        slots=slots,
-        touched=jnp.asarray(z["touched"]),
-        step=jnp.asarray(z["step"]),
-        globals=globals_,
-    )
+    # all arrays materialize inside the with: NpzFile reads lazily from the
+    # underlying zip and must be closed (fd leak otherwise)
+    with np.load(path) as z:
+        slots = {k[len("slot__"):]: jnp.asarray(z[k]) for k in z.files
+                 if k.startswith("slot__")}
+        globals_ = {k[len("global__"):]: jnp.asarray(z[k]) for k in z.files
+                    if k.startswith("global__")}
+        return LinearState(
+            weights=jnp.asarray(z["weights"]),
+            covars=jnp.asarray(z["covars"]) if "covars" in z.files else None,
+            slots=slots,
+            touched=jnp.asarray(z["touched"]),
+            step=jnp.asarray(z["step"]),
+            globals=globals_,
+        )

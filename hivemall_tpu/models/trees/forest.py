@@ -117,10 +117,7 @@ class TrainedForest:
         stacked = stack_trees([t.tree for t in self.trees])
         leaf_vals = np.asarray(predict_forest_binned(stacked, Xb))  # [T, N]
         if self.classification:
-            votes = np.zeros((X.shape[0], self.n_classes))
-            for t in range(leaf_vals.shape[0]):
-                votes[np.arange(X.shape[0]), leaf_vals[t].astype(int)] += 1
-            return np.argmax(votes, axis=1)
+            return forest_vote(leaf_vals, self.n_classes)
         return leaf_vals.mean(axis=0)
 
     def model_rows(self):
@@ -128,6 +125,31 @@ class TrainedForest:
         oob_errors, oob_tests) (ref: RandomForestClassifierUDTF.java:343-351)."""
         return [(t.model_id, t.model_type, t.model, t.var_importance.tolist(),
                  t.oob_errors, t.oob_tests) for t in self.trees]
+
+
+def forest_vote(leaf_vals: np.ndarray, n_classes: int) -> np.ndarray:
+    """Per-tree leaf classes [T, N] -> majority-vote class ids [N]. The one
+    aggregation both the trained object and the serving engine
+    (serving/engine.py) run, so they cannot diverge."""
+    n = leaf_vals.shape[1]
+    votes = np.zeros((n, n_classes))
+    for t in range(leaf_vals.shape[0]):
+        votes[np.arange(n), leaf_vals[t].astype(int)] += 1
+    return np.argmax(votes, axis=1)
+
+
+def gbt_decision_scores(leaf_vals: np.ndarray, intercept, shrinkage: float,
+                        n_rounds: int, n_class_trees: int) -> np.ndarray:
+    """Per-tree leaf outputs [n_rounds * K, N] (round-major) ->
+    intercept + shrinkage * per-class sums, [N, K]. Shared by
+    TrainedGBT.decision_function and the serving engine."""
+    n = leaf_vals.shape[1] if leaf_vals.ndim == 2 else 0
+    # intercept keeps its training dtype (f64 from the boosting fit)
+    scores = np.tile(np.asarray(intercept), (n, 1))
+    if leaf_vals.size:
+        contrib = leaf_vals.reshape(n_rounds, n_class_trees, n)
+        scores += shrinkage * contrib.sum(axis=0).T
+    return scores
 
 
 def _var_importance(tree: TreeArrays, F: int) -> np.ndarray:
@@ -270,14 +292,13 @@ class TrainedGBT:
         X = np.asarray(X, dtype=np.float64)
         Xb = bin_data(X, self.bins)
         K = len(self.intercept)
-        scores = np.tile(self.intercept, (X.shape[0], 1))
         flat = [t for round_trees in self.trees for t in round_trees]
-        if flat:
-            leaf_vals = np.asarray(predict_forest_binned(stack_trees(flat), Xb))
-            # rows are (round, class) in order
-            contrib = leaf_vals.reshape(len(self.trees), K, X.shape[0])
-            scores += self.shrinkage * contrib.sum(axis=0).T
-        return scores
+        if not flat:
+            return np.tile(self.intercept, (X.shape[0], 1))
+        # rows are (round, class) in order
+        leaf_vals = np.asarray(predict_forest_binned(stack_trees(flat), Xb))
+        return gbt_decision_scores(leaf_vals, self.intercept, self.shrinkage,
+                                   len(self.trees), K)
 
     def predict(self, X) -> np.ndarray:
         s = self.decision_function(X)

@@ -71,6 +71,70 @@ class ThroughputCounter:
             self.last_reads_per_sec = sum(c for _, c in self._events) / max(span, 1e-9)
 
 
+class Histogram:
+    """Fixed-bucket cumulative histogram (the Prometheus histogram shape).
+
+    `buckets` are upper bounds in ascending order; an implicit +Inf bucket
+    catches the tail. observe() is lock-guarded and O(len(buckets)) — cheap
+    enough for per-request latency recording on the serving path
+    (serving/engine.py, serving/batcher.py), and usable next to any
+    existing meter (e.g. per-block step walltime).
+    """
+
+    # Latency-shaped default: 500us .. 10s, roughly log-spaced (seconds).
+    DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+
+    def __init__(self, name: str, buckets=DEFAULT_BUCKETS) -> None:
+        self.name = name
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # [+Inf] is last
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        for i, ub in enumerate(self.buckets):
+            if v <= ub:
+                break
+        else:
+            i = len(self.buckets)
+        with self._lock:
+            self._counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def snapshot(self) -> dict:
+        """{"buckets": [(upper_bound, cumulative_count)...], "sum", "count"}
+        with the trailing +Inf bucket included (cumulative == count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self.count, self.sum
+        cum, out = 0, []
+        for ub, c in zip(self.buckets, counts):
+            cum += c
+            out.append((ub, cum))
+        out.append((float("inf"), total))
+        return {"buckets": out, "sum": s, "count": total}
+
+    def quantile(self, q: float) -> float:
+        """Bucket-resolution quantile estimate (upper bound of the bucket
+        holding the q-th observation) — for dashboards/logs; benches that
+        need exact percentiles keep raw samples. Ranks landing in the +Inf
+        overflow clamp to the largest finite bound (the Prometheus
+        histogram_quantile convention — and inf would break strict JSON)."""
+        snap = self.snapshot()
+        if not snap["count"] or not self.buckets:
+            return 0.0
+        rank = q * snap["count"]
+        for ub, cum in snap["buckets"]:
+            if cum >= rank and ub != float("inf"):
+                return ub
+        return self.buckets[-1]
+
+
 class MetricsRegistry:
     """Process-wide registry (the JMX MBean registry analog); exportable as a
     plain dict for scraping."""
@@ -79,6 +143,7 @@ class MetricsRegistry:
         self.counters: Dict[str, Counter] = {}
         self.throughput: Dict[str, ThroughputCounter] = {}
         self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, Histogram] = {}
         # registration and snapshot share one lock: the HTTP scrape thread
         # (runtime/metrics_http.py) iterates while the training thread may
         # be registering new keys
@@ -97,6 +162,14 @@ class MetricsRegistry:
                 self.throughput[name] = ThroughputCounter()
             return self.throughput[name]
 
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        with self._lock:
+            if name not in self.histograms:
+                self.histograms[name] = Histogram(
+                    name, buckets if buckets is not None
+                    else Histogram.DEFAULT_BUCKETS)
+            return self.histograms[name]
+
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self.gauges[name] = value
@@ -108,7 +181,30 @@ class MetricsRegistry:
                 out[key] = float(c.value)
             for name, t in self.throughput.items():
                 out[f"{name}.per_sec"] = t.last_reads_per_sec
+            hists = list(self.histograms.items())
+        # histogram locks are taken outside the registry lock (fixed order:
+        # registry -> histogram; nothing takes them in reverse)
+        for name, h in hists:
+            snap = h.snapshot()
+            out[f"{name}.count"] = float(snap["count"])
+            out[f"{name}.sum"] = float(snap["sum"])
         return out
+
+    def typed_snapshot(self) -> dict:
+        """Snapshot keeping metric kinds apart — the Prometheus exposition
+        (runtime/metrics_http.py) needs # TYPE per family."""
+        with self._lock:
+            counters = {k: float(c.value) for k, c in self.counters.items()}
+            gauges = dict(self.gauges)
+            meters = {f"{n}.per_sec": t.last_reads_per_sec
+                      for n, t in self.throughput.items()}
+            hists = list(self.histograms.items())
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "meters": meters,
+            "histograms": {n: h.snapshot() for n, h in hists},
+        }
 
 
 REGISTRY = MetricsRegistry()

@@ -33,11 +33,54 @@ def _prom_name(key: str) -> str:
     return _NAME_OK.sub("_", key.replace(".", "_"))
 
 
+def _fmt_le(ub: float) -> str:
+    if ub == float("inf"):
+        return "+Inf"
+    return repr(ub)
+
+
 def render_prometheus(snapshot: Optional[dict] = None) -> str:
-    snap = REGISTRY.snapshot() if snapshot is None else snapshot
+    """Prometheus text exposition with `# HELP` / `# TYPE` metadata.
+
+    With no argument, renders the process registry with true metric kinds
+    (counter / gauge / histogram; meters surface as gauges). Passing a plain
+    `{key: value}` snapshot renders every sample as an untyped gauge — the
+    legacy scrape shape, kept for callers that post-process dicts.
+    """
     lines = []
-    for key in sorted(snap):
-        lines.append(f"hivemall_tpu_{_prom_name(key)} {float(snap[key])}")
+
+    def head(name: str, kind: str, help_text: str) -> None:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+
+    if snapshot is not None:
+        for key in sorted(snapshot):
+            name = f"hivemall_tpu_{_prom_name(key)}"
+            head(name, "gauge", f"snapshot value {key}")
+            lines.append(f"{name} {float(snapshot[key])}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    snap = REGISTRY.typed_snapshot()
+    for key in sorted(snap["counters"]):
+        name = f"hivemall_tpu_{_prom_name(key)}"
+        head(name, "counter", f"monotonic counter {key}")
+        lines.append(f"{name} {snap['counters'][key]}")
+    for key in sorted(snap["gauges"]):
+        name = f"hivemall_tpu_{_prom_name(key)}"
+        head(name, "gauge", f"gauge {key}")
+        lines.append(f"{name} {float(snap['gauges'][key])}")
+    for key in sorted(snap["meters"]):
+        name = f"hivemall_tpu_{_prom_name(key)}"
+        head(name, "gauge", f"sliding-window throughput {key}")
+        lines.append(f"{name} {float(snap['meters'][key])}")
+    for key in sorted(snap["histograms"]):
+        h = snap["histograms"][key]
+        name = f"hivemall_tpu_{_prom_name(key)}"
+        head(name, "histogram", f"fixed-bucket histogram {key}")
+        for ub, cum in h["buckets"]:
+            lines.append(f'{name}_bucket{{le="{_fmt_le(ub)}"}} {cum}')
+        lines.append(f"{name}_sum {float(h['sum'])}")
+        lines.append(f"{name}_count {h['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

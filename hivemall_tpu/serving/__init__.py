@@ -1,0 +1,22 @@
+"""Online inference: frozen artifacts, bucketed engines, micro-batching,
+hot-swap registry + /predict endpoint — docs/serving.md.
+
+    from hivemall_tpu.serving import freeze, ModelRegistry, serve
+
+    freeze(model, "artifacts/ctr/1")
+    registry = ModelRegistry()
+    registry.deploy("ctr", "artifacts/ctr/1")
+    server = serve(registry, port=8080)
+"""
+
+from .artifact import Artifact, family_of, freeze, load
+from .batcher import BatcherClosed, DynamicBatcher, QueueFull
+from .engine import ServingEngine, make_servable
+from .server import ModelEntry, ModelRegistry, serve
+
+__all__ = [
+    "Artifact", "family_of", "freeze", "load",
+    "DynamicBatcher", "QueueFull", "BatcherClosed",
+    "ServingEngine", "make_servable",
+    "ModelRegistry", "ModelEntry", "serve",
+]

@@ -1,0 +1,329 @@
+"""Frozen serving artifacts — immutable, versioned, inference-only models.
+
+The reference's prediction story is offline: dump the model as a Hive table
+at close(), score with SQL joins (SURVEY.md §3.5). Online serving needs a
+different persistence contract (the immutable-artifact discipline of
+production scoring stacks, PAPERS.md ads-infra paper): a model version is a
+directory that never changes after `freeze()` —
+
+    <dir>/
+      manifest.json   # family, schema, shapes, sha256 of the array pack
+      arrays.npz      # every array needed to reproduce predict() bit-exactly
+
+`freeze(model, dir)` accepts any trained model the framework produces
+(linear, multiclass, FM, FFM, MF, random forest, GBT — the same family
+dispatch as adapters/model_rows.py, whose column schema is recorded in the
+manifest) and `load(dir)` returns an `Artifact`; `serving.engine.
+make_servable(artifact)` turns it into a jit-served predictor whose outputs
+are bit-identical to the live model's (tests/test_serving_artifact.py pins
+this for every family).
+
+Artifacts are *inference-only*: optimizer slots are dropped (io/checkpoint
+remains the mid-training resume path). The linear family stores the
+(feature, weight[, covar]) interchange rows — the exact npz layout of
+io/checkpoint.save_model_rows, reconstructed through dense_from_rows — and
+the FFM family stores the to_blob() compressed blob (utils/codec recipe),
+so both reuse the established codecs rather than inventing new ones.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+FORMAT = "hivemall-tpu-artifact"
+FORMAT_VERSION = 1
+MANIFEST_FILE = "manifest.json"
+ARRAYS_FILE = "arrays.npz"
+
+
+def _host(x) -> np.ndarray:
+    """Device array -> host numpy, bf16 widened to f32 (np.savez cannot
+    round-trip ml_dtypes reliably; the widening is value-exact)."""
+    import jax
+
+    a = np.asarray(jax.device_get(x))
+    if a.dtype.name == "bfloat16":
+        return a.astype(np.float32)
+    return a
+
+
+def family_of(model) -> str:
+    """Family tag for any trained model — the adapters/model_rows.py
+    dispatch order, as a name."""
+    from ..models.ffm import TrainedFFMModel
+    from ..models.fm import TrainedFMModel
+    from ..models.mf import TrainedMFModel
+    from ..models.trees.forest import TrainedForest, TrainedGBT
+
+    if isinstance(model, TrainedGBT):
+        return "gbt"
+    if isinstance(model, TrainedFMModel):
+        return "fm"
+    if isinstance(model, TrainedFFMModel):
+        return "ffm"
+    if isinstance(model, TrainedForest):
+        return "forest"
+    if isinstance(model, TrainedMFModel):
+        return "mf"
+    if hasattr(model, "label_vocab"):
+        return "multiclass"
+    if hasattr(model, "state") and hasattr(model.state, "weights"):
+        return "linear"
+    raise ValueError(f"{type(model).__name__}: no serving family")
+
+
+@dataclass
+class Artifact:
+    """A loaded artifact: manifest + host arrays (still inert — feed to
+    serving.engine.make_servable for a predictor)."""
+
+    path: str
+    manifest: dict
+    arrays: Dict[str, np.ndarray] = field(repr=False)
+
+    @property
+    def family(self) -> str:
+        return self.manifest["family"]
+
+    @property
+    def meta(self) -> dict:
+        return self.manifest["meta"]
+
+
+def _vocab_jsonable(vocab):
+    return [v.item() if hasattr(v, "item") else v for v in vocab]
+
+
+def _pack_trees(prefix: str, trees, arrays: dict) -> None:
+    for i, t in enumerate(trees):
+        arrays[f"{prefix}{i}__feature"] = np.asarray(t.feature, np.int32)
+        arrays[f"{prefix}{i}__threshold_bin"] = np.asarray(t.threshold_bin,
+                                                          np.int32)
+        arrays[f"{prefix}{i}__nominal"] = np.asarray(t.nominal, bool)
+        arrays[f"{prefix}{i}__left"] = np.asarray(t.left, np.int32)
+        arrays[f"{prefix}{i}__right"] = np.asarray(t.right, np.int32)
+        arrays[f"{prefix}{i}__leaf_value"] = np.asarray(t.leaf_value,
+                                                       np.float32)
+
+
+def _unpack_trees(prefix: str, n: int, arrays: dict):
+    from ..models.trees.grow import TreeArrays
+
+    out = []
+    for i in range(n):
+        feature = arrays[f"{prefix}{i}__feature"]
+        out.append(TreeArrays(
+            feature=feature,
+            threshold_bin=arrays[f"{prefix}{i}__threshold_bin"],
+            nominal=arrays[f"{prefix}{i}__nominal"],
+            left=arrays[f"{prefix}{i}__left"],
+            right=arrays[f"{prefix}{i}__right"],
+            leaf_dist=None,
+            leaf_value=arrays[f"{prefix}{i}__leaf_value"],
+            n_nodes=int(feature.shape[0]),
+        ))
+    return out
+
+
+def _pack_bins(bins, arrays: dict, meta: dict) -> None:
+    meta["bins_nominal"] = [bool(b.nominal) for b in bins]
+    for f, b in enumerate(bins):
+        arrays[f"bin{f}__edges"] = np.asarray(b.edges, np.float64)
+
+
+def _unpack_bins(meta: dict, arrays: dict):
+    from ..models.trees.binning import BinInfo
+
+    out = []
+    for f, nominal in enumerate(meta["bins_nominal"]):
+        edges = arrays[f"bin{f}__edges"]
+        out.append(BinInfo(bool(nominal), edges, len(edges)))
+    return out
+
+
+def _build_payload(model):
+    """(family, arrays dict, meta dict) for any trained model."""
+    from ..adapters.model_rows import iter_model_rows
+
+    family = family_of(model)
+    arrays: Dict[str, np.ndarray] = {}
+    meta: dict = {}
+    try:
+        meta["columns"], _ = iter_model_rows(model)
+    except ValueError:
+        meta["columns"] = None
+
+    if family == "linear":
+        # the io/checkpoint.save_model_rows interchange layout: untouched
+        # entries are 0 (weights) / 1 (covars) by construction, so
+        # dense_from_rows reproduces the live tables exactly
+        rows = model.model_rows()
+        arrays["feature"] = np.asarray(rows[0], np.int64)
+        arrays["weight"] = _host(rows[1])
+        if len(rows) == 3 and rows[2] is not None:
+            arrays["covar"] = _host(rows[2])
+        meta.update(dims=int(model.dims), rule=model.rule.name,
+                    use_covariance=bool(model.rule.use_covariance),
+                    weights_dtype=np.asarray(model.state.weights).dtype.name)
+    elif family == "multiclass":
+        st = model.state
+        arrays["weights"] = _host(st.weights)
+        if st.covars is not None:
+            arrays["covars"] = _host(st.covars)
+        meta.update(dims=int(model.dims),
+                    label_vocab=_vocab_jsonable(model.label_vocab),
+                    use_covariance=st.covars is not None)
+    elif family == "fm":
+        st, hy = model.state, model.hyper
+        for k in ("w0", "w", "v", "lambda_w0", "lambda_w", "lambda_v"):
+            arrays[k] = _host(getattr(st, k))
+        arrays["touched"] = _host(st.touched)
+        meta.update(dims=int(model.dims), factors=int(hy.factors),
+                    classification=bool(hy.classification),
+                    sigma=float(hy.sigma), seed=int(hy.seed),
+                    lambda0=float(hy.lambda0))
+    elif family == "ffm":
+        # the utils/codec compressed-blob recipe (FFMPredictionModel
+        # writeExternal analog); half_float=False keeps bit-exactness
+        blob = model.to_blob(half_float=False)
+        arrays["blob"] = np.frombuffer(blob, np.uint8)
+        hy = model.hyper
+        meta.update(factors=int(hy.factors),
+                    num_features=int(hy.num_features),
+                    num_fields=int(hy.num_fields), v_dims=int(hy.v_dims))
+    elif family == "mf":
+        st = model.state
+        for k in ("P", "Q", "Bu", "Bi", "mu"):
+            arrays[k] = _host(getattr(st, k))
+        meta.update(use_bias=bool(model.use_bias),
+                    num_users=int(arrays["P"].shape[0]),
+                    num_items=int(arrays["Q"].shape[0]),
+                    factor=int(arrays["P"].shape[1]))
+    elif family == "forest":
+        _pack_trees("tree", [t.tree for t in model.trees], arrays)
+        _pack_bins(model.bins, arrays, meta)
+        meta.update(n_trees=len(model.trees),
+                    classification=bool(model.classification),
+                    n_classes=int(model.n_classes),
+                    attrs=list(model.attrs))
+    elif family == "gbt":
+        flat = [t for round_trees in model.trees for t in round_trees]
+        _pack_trees("tree", flat, arrays)
+        _pack_bins(model.bins, arrays, meta)
+        arrays["intercept"] = np.asarray(model.intercept, np.float64)
+        arrays["classes"] = np.asarray(model.classes)
+        meta.update(n_rounds=len(model.trees),
+                    n_class_trees=len(model.trees[0]) if model.trees else 0,
+                    shrinkage=float(model.shrinkage))
+    return family, arrays, meta
+
+
+def freeze(model, path: str, *, name: Optional[str] = None,
+           version: Optional[str] = None) -> dict:
+    """Freeze a trained model into an immutable artifact directory.
+
+    Returns the manifest. The directory must not already hold an artifact
+    (versions are immutable — freeze a NEW directory and hot-swap it in via
+    serving.server.ModelRegistry.deploy).
+    """
+    os.makedirs(path, exist_ok=True)
+    mpath = os.path.join(path, MANIFEST_FILE)
+    if os.path.exists(mpath):
+        raise FileExistsError(
+            f"{mpath} exists — artifacts are immutable; freeze a new "
+            f"version directory instead")
+    family, arrays, meta = _build_payload(model)
+    apath = os.path.join(path, ARRAYS_FILE)
+    # savez into memory so the pack is written AND hashed in one pass (a
+    # large FM/FFM table would otherwise pay a second full-file read)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    data = buf.getvalue()
+    digest = hashlib.sha256(data).hexdigest()
+    with open(apath, "wb") as f:
+        f.write(data)
+    manifest = {
+        "format": FORMAT,
+        "format_version": FORMAT_VERSION,
+        "family": family,
+        "name": name or family,
+        "version": version or "1",
+        "created_unix": time.time(),
+        "arrays": ARRAYS_FILE,
+        "sha256": digest,
+        "meta": meta,
+    }
+    # atomic manifest publish: the artifact "exists" only once the rename
+    # lands, so a concurrent load never sees a half-written directory
+    fd, tmp = tempfile.mkstemp(dir=path, prefix=".manifest-")
+    with os.fdopen(fd, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, mpath)
+    return manifest
+
+
+def load(path: str, verify: bool = True) -> Artifact:
+    """Load an artifact directory (manifest + host arrays); verifies the
+    array pack against the manifest hash unless `verify=False`."""
+    with open(os.path.join(path, MANIFEST_FILE)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != FORMAT:
+        raise ValueError(f"{path}: not a {FORMAT} directory")
+    if manifest.get("format_version", 0) > FORMAT_VERSION:
+        raise ValueError(
+            f"{path}: artifact format v{manifest['format_version']} is newer "
+            f"than this runtime (v{FORMAT_VERSION})")
+    apath = os.path.join(path, manifest["arrays"])
+    # one read serves both the hash check and np.load — the deploy/hot-swap
+    # path should not pay double I/O on a large pack
+    with open(apath, "rb") as f:
+        data = f.read()
+    if verify:
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != manifest["sha256"]:
+            raise ValueError(f"{apath}: sha256 mismatch — artifact corrupt "
+                             f"or tampered")
+    with np.load(io.BytesIO(data)) as z:
+        arrays = {k: z[k] for k in z.files}
+    return Artifact(path=path, manifest=manifest, arrays=arrays)
+
+
+def rebuild_model(artifact: Artifact):
+    """Reconstruct a predictable model object from an artifact.
+
+    Families whose live predict path is a plain dataclass reconstruct the
+    original Trained* type; linear/multiclass return the state pytrees the
+    engine's jitted predictors consume (serving.engine wraps either shape).
+    """
+    a, meta = artifact.arrays, artifact.meta
+    family = artifact.family
+
+    if family == "ffm":
+        from ..models.ffm import TrainedFFMModel
+
+        return TrainedFFMModel.from_blob(a["blob"].tobytes())
+    if family == "mf":
+        import jax.numpy as jnp
+
+        from ..models.mf import MFState, TrainedMFModel
+
+        n_u, n_i = int(meta["num_users"]), int(meta["num_items"])
+        st = MFState(
+            P=jnp.asarray(a["P"]), Q=jnp.asarray(a["Q"]),
+            Bu=jnp.asarray(a["Bu"]), Bi=jnp.asarray(a["Bi"]),
+            mu=jnp.asarray(a["mu"]), P_gg=None, Q_gg=None,
+            touched_u=jnp.ones((n_u,), jnp.int8),
+            touched_i=jnp.ones((n_i,), jnp.int8),
+            step=jnp.zeros((), jnp.int32))
+        return TrainedMFModel(state=st, use_bias=bool(meta["use_bias"]))
+    raise ValueError(f"rebuild_model: family {family!r} is served via "
+                     f"serving.engine.make_servable, not a model object")

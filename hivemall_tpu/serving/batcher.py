@@ -1,0 +1,176 @@
+"""Dynamic micro-batching: keep the accelerator hot without unbounded queues.
+
+One request at a time under-fills the device (a [8, K] gather-dot costs the
+same dispatch as [512, K]); the batcher merges concurrent requests into one
+padded batch — the request-batching layer every production scoring stack
+carries (PAPERS.md ads-infra paper). Policy:
+
+- a batch closes when it holds ``max_batch`` rows OR the oldest queued
+  request has waited ``max_delay_ms`` (latency ceiling under light load,
+  full batches under heavy load);
+- admission control is explicit: a queue deeper than ``max_queue_rows``
+  REJECTS new work (`QueueFull` -> HTTP 503 in serving/server.py) instead
+  of growing an unbounded backlog — shed load early, keep served latency
+  bounded;
+- every request gets a `concurrent.futures.Future`; a worker failure fails
+  the affected requests, never the process.
+
+Metrics (runtime.metrics.REGISTRY): queue-depth gauge, batch-occupancy and
+queue-delay histograms, accepted/rejected counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Sequence
+
+from ..runtime.metrics import REGISTRY
+
+OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+DELAY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                 0.025, 0.05, 0.1, 0.25, 1.0)
+
+
+class QueueFull(RuntimeError):
+    """Admission control: queue at capacity — caller should shed (503)."""
+
+
+class BatcherClosed(RuntimeError):
+    """submit() after close()."""
+
+
+class _Pending:
+    __slots__ = ("instances", "future", "enqueued")
+
+    def __init__(self, instances) -> None:
+        self.instances = instances
+        self.future: Future = Future()
+        self.enqueued = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Micro-batching front of one ServingEngine (or any ``predict_fn``
+    taking a list of instances and returning an indexable of results)."""
+
+    def __init__(self, predict_fn: Callable[[List], Sequence], *,
+                 max_batch: int = 256, max_delay_ms: float = 2.0,
+                 max_queue_rows: int = 4096, name: str = "default") -> None:
+        self.predict_fn = predict_fn
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay_ms) / 1000.0
+        self.max_queue_rows = int(max_queue_rows)
+        self.name = name
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._depth_rows = 0
+        self._closed = False
+        self._accepted = REGISTRY.counter("serving", f"{name}.batcher.accepted")
+        self._rejected = REGISTRY.counter("serving", f"{name}.batcher.rejected")
+        self._occupancy = REGISTRY.histogram(
+            f"serving.{name}.batch_occupancy", OCCUPANCY_BUCKETS)
+        self._delay = REGISTRY.histogram(
+            f"serving.{name}.queue_delay_seconds", DELAY_BUCKETS)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"hivemall-batcher-{name}")
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def submit(self, instances: Sequence) -> Future:
+        """Enqueue one request (a list of instances); the Future resolves to
+        the list of predictions for exactly those instances, in order."""
+        if not instances:
+            f: Future = Future()
+            f.set_result([])
+            return f
+        p = _Pending(list(instances))
+        with self._cv:
+            if self._closed:
+                raise BatcherClosed(f"batcher {self.name!r} is closed")
+            if self._depth_rows + len(p.instances) > self.max_queue_rows:
+                self._rejected.increment()
+                raise QueueFull(
+                    f"batcher {self.name!r}: queue holds {self._depth_rows} "
+                    f"rows (cap {self.max_queue_rows}) — shed load")
+            self._q.append(p)
+            self._depth_rows += len(p.instances)
+            REGISTRY.set_gauge(f"serving.{self.name}.queue_depth_rows",
+                               float(self._depth_rows))
+            self._cv.notify()
+        self._accepted.increment()
+        return p.future
+
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting work. ``drain=True`` (the hot-swap path) lets the
+        worker finish everything already queued before the thread exits, so
+        an in-flight version swap fails zero requests."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                while self._q:
+                    p = self._q.popleft()
+                    p.future.set_exception(
+                        BatcherClosed(f"batcher {self.name!r} closed"))
+                self._depth_rows = 0
+            self._cv.notify_all()
+        self._thread.join(timeout=30.0)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _take_batch(self):
+        """Block for the first request, then gather more until max_batch or
+        the first request's max_delay deadline. Returns [] at shutdown."""
+        with self._cv:
+            while not self._q:
+                if self._closed:
+                    return []
+                self._cv.wait()
+            batch = [self._q.popleft()]
+            rows = len(batch[0].instances)
+            deadline = batch[0].enqueued + self.max_delay
+            while rows < self.max_batch:
+                if self._q:
+                    nxt = self._q[0]
+                    if rows + len(nxt.instances) > self.max_batch:
+                        break
+                    batch.append(self._q.popleft())
+                    rows += len(nxt.instances)
+                    continue
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or self._closed:
+                    break
+                self._cv.wait(timeout=remaining)
+            self._depth_rows -= rows
+            REGISTRY.set_gauge(f"serving.{self.name}.queue_depth_rows",
+                               float(self._depth_rows))
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                return
+            now = time.perf_counter()
+            rows: List = []
+            for p in batch:
+                self._delay.observe(now - p.enqueued)
+                rows.extend(p.instances)
+            self._occupancy.observe(len(rows))
+            try:
+                preds = self.predict_fn(rows)
+            except Exception as e:  # fail the batch, not the process
+                for p in batch:
+                    if not p.future.cancelled():
+                        p.future.set_exception(e)
+                continue
+            off = 0
+            for p in batch:
+                k = len(p.instances)
+                if not p.future.cancelled():
+                    p.future.set_result(list(preds[off:off + k]))
+                off += k

@@ -1,0 +1,488 @@
+"""Shape-bucketed online predictors — zero steady-state recompiles.
+
+XLA compiles one program per input shape, so a naive server retraces on
+every distinct (batch, row-width) pair — the recompilation-count failure
+mode the ads-infra paper tracks as a production metric (PAPERS.md). The
+serving discipline here is the training-side G001 discipline
+(core/batch.py) applied to inference:
+
+- row width pads to a power of two >= 8 (``pad_to_bucket``), capped at
+  ``max_width`` (longer rows truncate, counted);
+- batch size pads to a power of two >= ``min_batch_bucket``, capped at
+  ``max_batch`` (bigger requests chunk);
+- ``warmup()`` drives a dummy batch through EVERY (batch, width) bucket at
+  load time, so the steady state never compiles — witnessed at run time by
+  ``runtime.metrics.recompile_guard`` around every predict call
+  (counter ``graftcheck.recompiles.serving.<name>`` stays flat).
+
+Every family reuses the SAME jitted scorer its live model uses
+(core/engine.make_predict, models/fm._fm_scores, models/ffm._ffm_scores_jit,
+models/multiclass._mc_scores, models/trees/grow.predict_forest_binned), so
+served predictions are bit-identical to the trained object's — padding rows
+are row-independent no-ops. MF is the exception by design: its predict is a
+host-side embedding lookup (numpy gather-dot, no device batch work to
+amortize), identical to TrainedMFModel.predict.
+
+Attribution caveat: because those scorers (and their jit caches) are shared
+process-wide, a deploy WARMING another same-family model concurrently with
+an open predict guard can transiently attribute its warmup compiles to the
+serving engine's counter. The flat-counter invariant is exact whenever no
+deploy is in flight; sharing the cache is the point (a new version of the
+same shapes warms for free), so the counter trades per-engine attribution
+for that.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.batch import pack_rows, pad_to_bucket
+from ..runtime.metrics import REGISTRY, recompile_guard
+from .artifact import Artifact, family_of, load, rebuild_model
+
+# serving latency is sub-ms-to-seconds shaped; finer low end than the
+# metrics default
+LATENCY_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                   0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def _bf16_or(name: str):
+    import jax.numpy as jnp
+
+    return jnp.bfloat16 if name == "bfloat16" else name
+
+
+class _Servable:
+    """Family adapter: host staging + padded jitted scoring.
+
+    ``run_padded(instances, b_pad, width_cap)`` stages, pads to
+    ``[b_pad, width_bucket]`` and scores; ``finalize(raw, n)`` maps the
+    padded raw output back to ``n`` user-facing predictions.
+    """
+
+    family: str = ""
+    jit_fns: Tuple = ()
+    # families with a row-width axis warm up over width buckets; the rest
+    # only have the batch axis
+    has_width: bool = True
+
+    def run_padded(self, instances, b_pad: int, width_cap: int):
+        raise NotImplementedError
+
+    def finalize(self, raw, n: int):
+        return np.asarray(raw)[:n]
+
+    def dummy_instance(self, width: Optional[int]):
+        raise NotImplementedError
+
+    def max_nnz(self, instances) -> int:
+        return max((len(r) for r in instances), default=1)
+
+    def count_overwide(self, instances, width_cap: int) -> int:
+        """How many rows will actually truncate at ``width_cap`` — the
+        operator signal for sizing max_width (exact, not per-chunk)."""
+        return sum(1 for r in instances if len(r) > width_cap)
+
+
+class _SparseRowServable(_Servable):
+    """Shared staging for the "feature[:value]" row families (linear,
+    multiclass, FM): parse -> width-bucket -> one padded FeatureBlock.
+    Subclasses only provide the jitted score call."""
+
+    def __init__(self, dims: int) -> None:
+        self.dims = dims
+
+    def _pack(self, instances, b_pad: int, width_cap: int):
+        from ..models.base import _stage_rows
+
+        idx_rows, val_rows = _stage_rows(instances, self.dims)
+        n = len(idx_rows)
+        width = min(pad_to_bucket(self.max_nnz(idx_rows)), width_cap)
+        return pack_rows(idx_rows, val_rows, np.zeros(n), self.dims,
+                         width=width, batch_size=b_pad)
+
+    def dummy_instance(self, width):
+        return [(i, 1.0) for i in range(width)]
+
+
+class _LinearServable(_SparseRowServable):
+    family = "linear"
+
+    def __init__(self, state, dims: int) -> None:
+        from ..core.engine import make_predict
+
+        super().__init__(dims)
+        self.state = state
+        self._predict = make_predict(use_covariance=False)
+        self.jit_fns = (self._predict,)
+
+    def run_padded(self, instances, b_pad, width_cap):
+        blk = self._pack(instances, b_pad, width_cap)
+        return self._predict(self.state, blk.indices, blk.values)
+
+
+class _MulticlassServable(_SparseRowServable):
+    family = "multiclass"
+
+    def __init__(self, state, label_vocab, dims: int) -> None:
+        from ..models.multiclass import _mc_scores
+
+        super().__init__(dims)
+        self.state = state
+        self.label_vocab = list(label_vocab)
+        self._scores = _mc_scores
+        self.jit_fns = (_mc_scores,)
+
+    def run_padded(self, instances, b_pad, width_cap):
+        blk = self._pack(instances, b_pad, width_cap)
+        return self._scores(self.state.weights, blk.indices, blk.values)
+
+    def finalize(self, raw, n):
+        scores = np.asarray(raw)[:n]
+        return [self.label_vocab[i] for i in np.argmax(scores, axis=1)]
+
+
+class _FMServable(_SparseRowServable):
+    family = "fm"
+
+    def __init__(self, state, dims: int) -> None:
+        from ..models.fm import _fm_scores
+
+        super().__init__(dims)
+        self.state = state
+        self._scores = _fm_scores
+        self.jit_fns = (_fm_scores,)
+
+    def run_padded(self, instances, b_pad, width_cap):
+        blk = self._pack(instances, b_pad, width_cap)
+        return self._scores(self.state, blk.indices, blk.values)
+
+
+class _FFMServable(_Servable):
+    family = "ffm"
+
+    def __init__(self, state, hyper) -> None:
+        from ..models.ffm import _ffm_scores_jit
+
+        self.state = state
+        self.hyper = hyper
+        self._scores = _ffm_scores_jit
+        self.jit_fns = (_ffm_scores_jit,)
+
+    def run_padded(self, instances, b_pad, width_cap):
+        from ..utils.feature import FMFeature
+
+        hy = self.hyper
+        parsed = [[FMFeature.parse(f, num_features=hy.num_features,
+                                   num_fields=hy.num_fields) for f in row]
+                  for row in instances]
+        width = min(pad_to_bucket(self.max_nnz(parsed)), width_cap)
+        idx = np.full((b_pad, width), hy.num_features, np.int32)
+        val = np.zeros((b_pad, width), np.float32)
+        fld = np.zeros((b_pad, width), np.int32)
+        for r, row in enumerate(parsed):
+            for c, f in enumerate(row[:width]):
+                idx[r, c] = f.index % hy.num_features
+                val[r, c] = f.value
+                fld[r, c] = (f.field if f.field >= 0 else 0) % hy.num_fields
+        return self._scores(hy, self.state, idx, val, fld)
+
+    def dummy_instance(self, width):
+        return [f"{k % 8}:{k}:1.0" for k in range(width)]
+
+
+class _MFServable(_Servable):
+    """Host-side embedding lookup — numpy gather-dot, bit-identical to
+    TrainedMFModel.predict; there is no [B, K] device batch shape to
+    bucket, so has_width is False and jit_fns is empty."""
+
+    family = "mf"
+    has_width = False
+
+    def __init__(self, model) -> None:
+        self.model = model
+
+    def run_padded(self, instances, b_pad, width_cap):
+        pairs = np.asarray(instances, np.int64).reshape(len(instances), 2)
+        u = np.zeros(b_pad, np.int64)
+        i = np.zeros(b_pad, np.int64)
+        u[:len(instances)] = pairs[:, 0]
+        i[:len(instances)] = pairs[:, 1]
+        return self.model.predict(u, i)
+
+    def dummy_instance(self, width):
+        return (0, 0)
+
+
+class _TreeServable(_Servable):
+    """Shared host binning + padded vmapped tree walk (forest, GBT)."""
+
+    has_width = False
+
+    def __init__(self, trees_flat, bins) -> None:
+        from ..models.trees.grow import predict_forest_binned, stack_trees
+
+        self.bins = bins
+        self.n_features = len(bins)
+        self.stacked = stack_trees(trees_flat) if trees_flat else None
+        self._walk = predict_forest_binned
+        self.jit_fns = (predict_forest_binned,)
+
+    def _binned_padded(self, instances, b_pad):
+        from ..models.trees.binning import bin_data
+
+        X = np.asarray(instances, np.float64).reshape(len(instances),
+                                                      self.n_features)
+        Xb = np.zeros((b_pad, self.n_features), np.int32)
+        Xb[:len(instances)] = bin_data(X, self.bins)
+        return Xb
+
+    def run_padded(self, instances, b_pad, width_cap):
+        Xb = self._binned_padded(instances, b_pad)
+        if self.stacked is None:
+            return np.zeros((0, b_pad))
+        return self._walk(self.stacked, Xb)
+
+    def dummy_instance(self, width):
+        return [0.0] * self.n_features
+
+
+class _ForestServable(_TreeServable):
+    family = "forest"
+
+    def __init__(self, trees, bins, classification: bool,
+                 n_classes: int) -> None:
+        super().__init__(trees, bins)
+        self.classification = classification
+        self.n_classes = n_classes
+
+    def finalize(self, raw, n):
+        from ..models.trees.forest import forest_vote
+
+        leaf_vals = np.asarray(raw)[:, :n]  # [T, n]
+        if self.classification:
+            return forest_vote(leaf_vals, self.n_classes)
+        return leaf_vals.mean(axis=0)
+
+
+class _GBTServable(_TreeServable):
+    family = "gbt"
+
+    def __init__(self, trees_flat, n_rounds: int, n_class_trees: int,
+                 intercept, shrinkage: float, classes, bins) -> None:
+        super().__init__(trees_flat, bins)
+        self.n_rounds = n_rounds
+        self.K = n_class_trees
+        self.intercept = np.asarray(intercept, np.float64)
+        self.shrinkage = float(shrinkage)
+        self.classes = np.asarray(classes)
+
+    def finalize(self, raw, n):
+        from ..models.trees.forest import gbt_decision_scores
+
+        leaf_vals = np.asarray(raw)[:, :n]
+        scores = gbt_decision_scores(leaf_vals, self.intercept,
+                                     self.shrinkage, self.n_rounds, self.K)
+        if scores.shape[1] == 1:
+            return self.classes[(scores[:, 0] > 0).astype(int)]
+        return self.classes[np.argmax(scores, axis=1)]
+
+
+def _servable_from_artifact(art: Artifact) -> _Servable:
+    import jax.numpy as jnp
+
+    meta = art.meta
+    a = art.arrays
+    if art.family == "linear":
+        from ..core.state import init_linear_state
+        from ..io.checkpoint import dense_from_rows
+
+        w, c = dense_from_rows(int(meta["dims"]), a["feature"], a["weight"],
+                               a.get("covar"))
+        state = init_linear_state(
+            int(meta["dims"]), use_covariance=bool(meta["use_covariance"]),
+            dtype=_bf16_or(meta.get("weights_dtype", "float32")),
+            initial_weights=w, initial_covars=c)
+        return _LinearServable(state, int(meta["dims"]))
+    if art.family == "multiclass":
+        from ..models.multiclass import MulticlassState
+
+        weights = jnp.asarray(a["weights"])
+        state = MulticlassState(
+            weights=weights,
+            covars=jnp.asarray(a["covars"]) if "covars" in a else None,
+            touched=jnp.ones(weights.shape, jnp.int8),
+            step=jnp.zeros((), jnp.int32))
+        return _MulticlassServable(state, meta["label_vocab"],
+                                   int(meta["dims"]))
+    if art.family == "fm":
+        from ..models.fm import FMState
+
+        state = FMState(
+            w0=jnp.asarray(a["w0"]), w=jnp.asarray(a["w"]),
+            v=jnp.asarray(a["v"]), lambda_w0=jnp.asarray(a["lambda_w0"]),
+            lambda_w=jnp.asarray(a["lambda_w"]),
+            lambda_v=jnp.asarray(a["lambda_v"]),
+            touched=jnp.asarray(a["touched"]),
+            step=jnp.zeros((), jnp.int32))
+        return _FMServable(state, int(meta["dims"]))
+    if art.family == "ffm":
+        model = rebuild_model(art)
+        return _FFMServable(model.state, model.hyper)
+    if art.family == "mf":
+        return _MFServable(rebuild_model(art))
+    if art.family == "forest":
+        from .artifact import _unpack_bins, _unpack_trees
+
+        trees = _unpack_trees("tree", int(meta["n_trees"]), a)
+        return _ForestServable(trees, _unpack_bins(meta, a),
+                               bool(meta["classification"]),
+                               int(meta["n_classes"]))
+    if art.family == "gbt":
+        from .artifact import _unpack_bins, _unpack_trees
+
+        n = int(meta["n_rounds"]) * int(meta["n_class_trees"])
+        trees = _unpack_trees("tree", n, a)
+        return _GBTServable(trees, int(meta["n_rounds"]),
+                            int(meta["n_class_trees"]), a["intercept"],
+                            float(meta["shrinkage"]), a["classes"],
+                            _unpack_bins(meta, a))
+    raise ValueError(f"unknown artifact family {art.family!r}")
+
+
+def _servable_from_model(model) -> _Servable:
+    family = family_of(model)
+    if family == "linear":
+        return _LinearServable(model.state, model.dims)
+    if family == "multiclass":
+        return _MulticlassServable(model.state, model.label_vocab, model.dims)
+    if family == "fm":
+        return _FMServable(model.state, model.dims)
+    if family == "ffm":
+        return _FFMServable(model.state, model.hyper)
+    if family == "mf":
+        return _MFServable(model)
+    if family == "forest":
+        return _ForestServable([t.tree for t in model.trees], model.bins,
+                               model.classification, model.n_classes)
+    if family == "gbt":
+        flat = [t for round_trees in model.trees for t in round_trees]
+        return _GBTServable(flat, len(model.trees),
+                            len(model.trees[0]) if model.trees else 0,
+                            model.intercept, model.shrinkage, model.classes,
+                            model.bins)
+    raise ValueError(f"unknown family {family!r}")
+
+
+def make_servable(obj) -> _Servable:
+    """Artifact | artifact dir path | trained model -> family servable."""
+    if isinstance(obj, str):
+        obj = load(obj)
+    if isinstance(obj, Artifact):
+        return _servable_from_artifact(obj)
+    return _servable_from_model(obj)
+
+
+class ServingEngine:
+    """Bucketed, warmed, metered predictor for one model version.
+
+    `predict(instances)` is thread-safe for the jitted families (the state
+    is immutable and jit dispatch is reentrant); the dynamic batcher
+    (serving/batcher.py) serializes calls anyway so each batch is one
+    device dispatch.
+    """
+
+    def __init__(self, source, *, name: str = "default",
+                 max_batch: int = 512, max_width: int = 256,
+                 min_batch_bucket: int = 8) -> None:
+        if max_batch < min_batch_bucket:
+            raise ValueError("max_batch must be >= min_batch_bucket")
+        self.servable = source if isinstance(source, _Servable) \
+            else make_servable(source)
+        self.family = self.servable.family
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.max_width = int(max_width)
+        self.min_batch_bucket = int(min_batch_bucket)
+        self._latency = REGISTRY.histogram(
+            f"serving.{name}.predict_seconds", LATENCY_BUCKETS)
+        self._rows = REGISTRY.counter("serving", f"{name}.rows")
+        self._truncated = REGISTRY.counter("serving", f"{name}.truncated_rows")
+        self.warmed_buckets: List[Tuple[int, Optional[int]]] = []
+
+    # -- buckets -------------------------------------------------------------
+
+    def batch_buckets(self) -> List[int]:
+        out, b = [], self.min_batch_bucket
+        while b < self.max_batch:
+            out.append(b)
+            b <<= 1
+        out.append(self.max_batch)
+        return out
+
+    def width_buckets(self) -> List[Optional[int]]:
+        if not self.servable.has_width:
+            return [None]
+        out, w = [], 8
+        while w < self.max_width:
+            out.append(w)
+            w <<= 1
+        out.append(self.max_width)
+        return out
+
+    def bucket_batch(self, n: int) -> int:
+        b = self.min_batch_bucket
+        while b < n:
+            b <<= 1
+        return min(b, self.max_batch)
+
+    # -- serving -------------------------------------------------------------
+
+    def warmup(self) -> int:
+        """Precompile every (batch, width) bucket; returns the number of jit
+        cache misses the sweep cost (all of them paid here, none in steady
+        state). Idempotent — a second warmup compiles nothing."""
+        t0 = time.perf_counter()
+        with recompile_guard(f"serving.{self.name}.warmup",
+                             *self.servable.jit_fns) as g:
+            for width in self.width_buckets():
+                inst = self.servable.dummy_instance(width or 8)
+                for b in self.batch_buckets():
+                    raw = self.servable.run_padded([inst], b, self.max_width)
+                    self.servable.finalize(raw, 1)
+                    self.warmed_buckets.append((b, width))
+        REGISTRY.set_gauge(f"serving.{self.name}.warmup_seconds",
+                           time.perf_counter() - t0)
+        REGISTRY.set_gauge(f"serving.{self.name}.warmup_compiles",
+                           float(g.compiles))
+        return g.compiles
+
+    def predict(self, instances: Sequence):
+        """Score a request of any size (chunks above max_batch)."""
+        n = len(instances)
+        if n == 0:
+            return []
+        t0 = time.perf_counter()
+        outs = []
+        for s in range(0, n, self.max_batch):
+            chunk = instances[s:s + self.max_batch]
+            if self.servable.has_width:
+                overwide = self.servable.count_overwide(chunk, self.max_width)
+                if overwide:
+                    self._truncated.increment(overwide)
+            b_pad = self.bucket_batch(len(chunk))
+            with recompile_guard(f"serving.{self.name}",
+                                 *self.servable.jit_fns):
+                raw = self.servable.run_padded(chunk, b_pad, self.max_width)
+                out = self.servable.finalize(raw, len(chunk))
+            outs.append(out)
+        self._rows.increment(n)
+        self._latency.observe(time.perf_counter() - t0)
+        if len(outs) == 1:
+            return outs[0]
+        if isinstance(outs[0], np.ndarray):
+            return np.concatenate(outs)
+        return [x for o in outs for x in o]

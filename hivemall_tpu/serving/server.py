@@ -1,0 +1,259 @@
+"""Multi-model registry with atomic hot-swap + the /predict HTTP endpoint.
+
+The reference swaps models by overwriting a Hive table between batch scoring
+runs; an online server must swap under live load. The registry keeps one
+``(engine, batcher)`` pair per model name; ``deploy()`` builds and WARMS the
+new version off to the side, then publishes it with one dict assignment
+(atomic under the GIL — readers see either the old or the new entry, never
+a partial one) and drains the old batcher so every request admitted before
+the swap still completes: an in-flight v1 -> v2 swap fails zero requests
+(tests/test_serving_server.py pins this).
+
+HTTP surface (layered on runtime/metrics_http.py — same process, one port):
+
+- ``POST /predict``  body ``{"model": name?, "instances": [...]}`` ->
+  ``{"model", "version", "predictions": [...]}``; 503 + Retry-After under
+  backpressure (batcher QueueFull), 404 unknown model, 400 bad payload;
+- ``GET /models``    registry listing (name, version, family, counters);
+- ``GET /metrics`` / ``GET /healthz`` — inherited from metrics_http, now
+  carrying the serving latency/occupancy/queue histograms.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..runtime import metrics_http
+from ..runtime.metrics import REGISTRY
+from .batcher import BatcherClosed, DynamicBatcher, QueueFull
+from .engine import ServingEngine
+
+
+class ModelEntry:
+    """One deployed model version: engine + its batching front."""
+
+    def __init__(self, name: str, version: str, engine: ServingEngine,
+                 batcher: DynamicBatcher) -> None:
+        self.name = name
+        self.version = version
+        self.engine = engine
+        self.batcher = batcher
+        self.deployed_unix = time.time()
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "family": self.engine.family,
+            "deployed_unix": self.deployed_unix,
+            "max_batch": self.engine.max_batch,
+            "max_width": self.engine.max_width,
+        }
+
+
+class ModelRegistry:
+    """name -> ModelEntry with atomic version swap.
+
+    Reads (`get`) are lock-free dict lookups; writes serialize on a lock.
+    A handler thread holds the ENTRY it resolved, not the name, so a swap
+    never invalidates an in-flight request — the old batcher drains.
+    """
+
+    def __init__(self, *, max_batch: int = 256, max_delay_ms: float = 2.0,
+                 max_queue_rows: int = 4096, warmup: bool = True,
+                 engine_kwargs: Optional[dict] = None) -> None:
+        self._entries: Dict[str, ModelEntry] = {}
+        self._lock = threading.Lock()
+        self.max_batch = max_batch
+        self.max_delay_ms = max_delay_ms
+        self.max_queue_rows = max_queue_rows
+        self.warmup = warmup
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self._swaps = REGISTRY.counter("serving", "registry.swaps")
+
+    def deploy(self, name: str, source, version: Optional[str] = None,
+               **engine_overrides) -> ModelEntry:
+        """Deploy `source` (artifact dir path, Artifact, or trained model)
+        as `name`; replaces any current version atomically AFTER the new
+        engine is fully warmed (no cold-cache window under load). The
+        version defaults to the artifact's manifest version (so /predict
+        responses correlate with the frozen directory, rollbacks included);
+        bare model objects auto-increment."""
+        from .artifact import Artifact, load as load_artifact
+
+        if isinstance(source, str):
+            source = load_artifact(source)
+        if version is None and isinstance(source, Artifact):
+            version = source.manifest.get("version")
+        kw = dict(self.engine_kwargs)
+        kw.update(engine_overrides)
+        kw.setdefault("max_batch", self.max_batch)
+        engine = ServingEngine(source, name=name, **kw)
+        if version is None:
+            with self._lock:
+                old = self._entries.get(name)
+            version = str(int(old.version) + 1) if old is not None \
+                and old.version.isdigit() else "1"
+        if self.warmup:
+            engine.warmup()
+        batcher = DynamicBatcher(
+            engine.predict, max_batch=engine.max_batch,
+            max_delay_ms=self.max_delay_ms,
+            max_queue_rows=self.max_queue_rows, name=name)
+        entry = ModelEntry(name, str(version), engine, batcher)
+        with self._lock:
+            old = self._entries.get(name)
+            self._entries[name] = entry  # the atomic publish
+        if old is not None:
+            self._swaps.increment()
+            # outside the lock: draining can take max_delay + a batch
+            old.batcher.close(drain=True)
+        REGISTRY.set_gauge(f"serving.{name}.deployed_version",
+                           float(version) if str(version).isdigit() else 0.0)
+        return entry
+
+    def get(self, name: Optional[str] = None) -> Optional[ModelEntry]:
+        """Resolve a model by name; with one deployed model, name may be
+        omitted (the single-model convenience every demo uses)."""
+        if name is not None:
+            return self._entries.get(name)  # atomic dict read
+        with self._lock:  # a concurrent first deploy mutates the dict
+            entries = list(self._entries.values())
+        if len(entries) == 1:
+            return entries[0]
+        return None
+
+    # each BatcherClosed means a full deploy landed between resolve and
+    # submit; needing this many consecutive swaps inside one submit window
+    # is not a reachable steady state
+    _SWAP_RETRIES = 8
+
+    def submit(self, name: Optional[str], instances):
+        """Resolve + enqueue, retrying across hot swaps: a caller that
+        resolved the OLD entry right before deploy() published the new one
+        sees BatcherClosed from the draining batcher — re-resolving gets
+        the new version, so a swap fails zero requests. Returns
+        (entry, future); (None, None) means the name is genuinely unknown
+        (never deployed, or undeployed). QueueFull propagates (backpressure
+        is the caller's 503); BatcherClosed escapes only after
+        _SWAP_RETRIES consecutive swap collisions (retryable, also 503)."""
+        for _ in range(self._SWAP_RETRIES):
+            entry = self.get(name)
+            if entry is None:
+                return None, None
+            try:
+                return entry, entry.batcher.submit(instances)
+            except BatcherClosed:
+                continue
+        raise BatcherClosed(
+            f"model {name!r}: {self._SWAP_RETRIES} consecutive version "
+            f"swaps collided with this submit — retry")
+
+    def undeploy(self, name: str) -> bool:
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        if entry is None:
+            return False
+        entry.batcher.close(drain=True)
+        return True
+
+    def list_models(self):
+        with self._lock:  # a first deploy of a new name mutates the dict
+            entries = list(self._entries.values())
+        return [e.describe() for e in entries]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries = {}
+        for e in entries:
+            e.batcher.close(drain=True)
+
+
+class _ServingHandler(metrics_http._Handler):
+    """Extends the metrics handler with /predict and /models. The registry
+    rides on the server object (see serve())."""
+
+    predict_timeout = 30.0
+
+    def _send_json(self, code: int, payload: dict, extra_headers=()) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        if self.path.split("?")[0] == "/models":
+            self._send_json(200, {"models": self.server.registry.list_models()})
+            return
+        super().do_GET()
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        if self.path.split("?")[0] != "/predict":
+            self._send_json(404, {"error": "not found"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            instances = payload["instances"]
+            if not isinstance(instances, list):
+                raise TypeError("instances must be a list")
+        except (KeyError, TypeError, ValueError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        t0 = time.perf_counter()
+        try:
+            # registry.submit retries across a hot swap, so a v1->v2 deploy
+            # never fails a request; only an unknown name / undeploy 404s
+            entry, future = self.server.registry.submit(
+                payload.get("model"), instances)
+            if entry is None:
+                self._send_json(404, {"error": f"unknown model "
+                                               f"{payload.get('model')!r}"})
+                return
+            preds = future.result(timeout=self.predict_timeout)
+        except (QueueFull, BatcherClosed) as e:
+            self._send_json(503, {"error": str(e)},
+                            extra_headers=(("Retry-After", "1"),))
+            return
+        except Exception as e:  # scoring bug — surface, don't hang
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self.server.latency.observe(time.perf_counter() - t0)
+        self._send_json(200, {
+            "model": entry.name,
+            "version": entry.version,
+            "predictions": [_jsonable(p) for p in preds],
+        })
+
+
+def _jsonable(p):
+    if isinstance(p, (np.generic,)):
+        return p.item()
+    if isinstance(p, np.ndarray):
+        return p.tolist()
+    return p
+
+
+def serve(registry: ModelRegistry, port: int = 0, host: str = "127.0.0.1"
+          ) -> ThreadingHTTPServer:
+    """Start the serving endpoint on a daemon thread (stdlib only, the
+    serve_metrics recipe); ``server.server_address[1]`` is the bound port.
+    The same server answers /predict, /models, /metrics and /healthz."""
+    server = ThreadingHTTPServer((host, port), _ServingHandler)
+    server.registry = registry
+    server.latency = REGISTRY.histogram("serving.http.latency_seconds")
+    t = threading.Thread(target=server.serve_forever, daemon=True,
+                         name="hivemall-tpu-serving")
+    t.start()
+    return server

@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""Serving bench: open- and closed-loop throughput + latency percentiles.
+
+Measures the in-process serving stack (ServingEngine + DynamicBatcher —
+the same objects the /predict endpoint drives, minus HTTP parse noise):
+
+- **closed loop**: T worker threads each issue sequential requests and wait
+  (throughput under a fixed concurrency, the classic saturation probe);
+- **open loop**: requests arrive at a fixed rate regardless of completions
+  (the coordinated-omission-free latency probe — queueing delay shows up in
+  the numbers instead of silently throttling the load generator).
+
+Verifies the two serving invariants while measuring:
+- after warmup, a request sweep spanning every shape bucket leaves the
+  `graftcheck.recompiles.serving.*` counter FLAT (zero steady-state
+  recompiles);
+- an in-flight v1 -> v2 hot swap completes with zero failed requests.
+
+Output: one BENCH-style JSON line (the bench.py shape). `--smoke` runs a
+seconds-scale version and exits non-zero if an invariant breaks — wired
+into scripts/test.sh as the serving smoke gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")  # noqa: E402 — runnable as scripts/bench_serving.py
+
+from hivemall_tpu.runtime.metrics import REGISTRY  # noqa: E402
+from hivemall_tpu.serving import (DynamicBatcher, ServingEngine,  # noqa: E402
+                                  load)
+
+
+def _train_default(dims: int, n_rows: int, seed: int = 7):
+    from hivemall_tpu.models.classifier import train_arow
+
+    rng = np.random.RandomState(seed)
+    rows = [[f"{rng.randint(dims)}:{rng.rand():.3f}"
+             for _ in range(rng.randint(4, 14))] for _ in range(n_rows)]
+    labels = rng.choice([-1, 1], n_rows)
+    return train_arow(rows, labels, f"-dims {dims}"), rows
+
+
+def _request_pool(rows, n_requests: int, k: int, seed: int = 13):
+    rng = np.random.RandomState(seed)
+    pool = []
+    for _ in range(n_requests):
+        take = rng.randint(1, k + 1)
+        idx = rng.randint(0, len(rows), take)
+        pool.append([rows[i] for i in idx])
+    return pool
+
+
+def _percentiles(lat_s):
+    lat_ms = np.asarray(lat_s) * 1000.0
+    return {p: float(np.percentile(lat_ms, p)) for p in (50, 95, 99)}
+
+
+def closed_loop(batcher, pool, concurrency: int):
+    lat, errors = [], []
+    lock = threading.Lock()
+    it = iter(pool)
+
+    def worker():
+        while True:
+            with lock:
+                req = next(it, None)
+            if req is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                batcher.submit(req).result(timeout=60)
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                lat.append(dt)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return lat, wall, errors
+
+
+def open_loop(batcher, pool, rate_rps: float):
+    """Fixed-rate arrivals; latency = completion - SCHEDULED arrival (no
+    coordinated omission)."""
+    period = 1.0 / rate_rps
+    pending, lat, errors = [], [], []
+    lock = threading.Lock()
+    start = time.perf_counter()
+    for i, req in enumerate(pool):
+        sched = start + i * period
+        now = time.perf_counter()
+        if sched > now:
+            time.sleep(sched - now)
+        try:
+            fut = batcher.submit(req)
+        except Exception as e:  # backpressure rejections count as errors
+            errors.append(repr(e))
+            continue
+
+        def _done(f, sched=sched):
+            # completion is stamped HERE, on the batcher worker thread —
+            # stamping at collection time would charge early requests for
+            # the whole submit phase
+            done = time.perf_counter()
+            with lock:
+                if f.exception() is not None:
+                    errors.append(repr(f.exception()))
+                else:
+                    lat.append(done - sched)
+
+        fut.add_done_callback(_done)
+        pending.append(fut)
+    for fut in pending:
+        try:
+            fut.result(timeout=60)
+        except Exception:
+            pass  # recorded by the callback
+    wall = time.perf_counter() - start
+    return lat, wall, errors
+
+
+def hot_swap_probe(model_factory, batcher_kw, engine_kw, pool,
+                   concurrency: int):
+    """Hammer a registry-held model from `concurrency` threads while
+    swapping v1 -> v2; returns (requests_served, failures)."""
+    from hivemall_tpu.serving import ModelRegistry
+
+    registry = ModelRegistry(max_delay_ms=batcher_kw["max_delay_ms"],
+                             engine_kwargs=engine_kw)
+    registry.deploy("bench", model_factory(1), version="1")
+    served, failures = [], []
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def hammer(i):
+        j = 0
+        while not stop.is_set():
+            try:
+                # registry.submit retries across the swap (the same path
+                # the /predict handler uses)
+                _, fut = registry.submit("bench",
+                                         pool[(i * 31 + j) % len(pool)])
+                fut.result(timeout=60)
+                with lock:
+                    served.append(1)
+            except Exception as e:
+                with lock:
+                    failures.append(repr(e))
+            j += 1
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    registry.deploy("bench", model_factory(2), version="2")
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    registry.shutdown()
+    return len(served), failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--artifact", help="serve this artifact dir instead of "
+                                       "training a tiny AROW model")
+    ap.add_argument("--dims", type=int, default=1 << 16)
+    ap.add_argument("--train-rows", type=int, default=2000)
+    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--instances-per-request", type=int, default=8)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rate", type=float, default=500.0,
+                    help="open-loop arrival rate, req/s")
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--max-width", type=int, default=64)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale run; exit non-zero on any "
+                         "invariant violation (scripts/test.sh gate)")
+    args = ap.parse_args()
+    if args.smoke:
+        args.dims = 1 << 10
+        args.train_rows = 300
+        args.requests = 300
+        args.concurrency = 4
+        args.rate = 300.0
+        args.max_batch = 64
+        args.max_width = 32
+
+    if args.artifact:
+        source = load(args.artifact)
+        rows = None
+        tag = source.manifest["name"]
+    else:
+        model, rows = _train_default(args.dims, args.train_rows)
+        source = model
+        tag = f"arow_{args.dims}dims"
+
+    engine_kw = {"max_batch": args.max_batch, "max_width": args.max_width}
+    engine = ServingEngine(source, name="bench", **engine_kw)
+    t0 = time.perf_counter()
+    warm_compiles = engine.warmup()
+    warm_s = time.perf_counter() - t0
+    if rows is None:
+        raise SystemExit("--artifact benching needs a request generator for "
+                         "its family; only the default AROW flow ships one")
+    pool = _request_pool(rows, args.requests, args.instances_per_request)
+
+    batcher_kw = {"max_batch": args.max_batch,
+                  "max_delay_ms": args.max_delay_ms}
+    guard = REGISTRY.counter("graftcheck", "recompiles.serving.bench")
+
+    # -- closed loop ---------------------------------------------------------
+    batcher = DynamicBatcher(engine.predict, name="bench", **batcher_kw)
+    recompiles0 = guard.value
+    closed_lat, closed_wall, closed_err = closed_loop(
+        batcher, pool, args.concurrency)
+    batcher.close()
+    closed_p = _percentiles(closed_lat)
+
+    # -- open loop -----------------------------------------------------------
+    batcher = DynamicBatcher(engine.predict, name="bench", **batcher_kw)
+    open_lat, open_wall, open_err = open_loop(batcher, pool, args.rate)
+    batcher.close()
+    open_p = _percentiles(open_lat) if open_lat else {50: 0, 95: 0, 99: 0}
+    steady_recompiles = guard.value - recompiles0
+
+    # -- hot swap under load -------------------------------------------------
+    def factory(v):
+        return _train_default(args.dims, args.train_rows, seed=v)[0]
+
+    swap_served, swap_failures = hot_swap_probe(
+        factory, batcher_kw, engine_kw, pool, args.concurrency)
+
+    occupancy = REGISTRY.histogram("serving.bench.batch_occupancy")
+    result = {
+        "metric": f"serving_closed_loop_throughput_{tag}",
+        "value": round(len(closed_lat) / closed_wall, 1),
+        "unit": "req/s",
+        "methodology": "in_process_batcher_closed_loop",
+        "steady_state_recompiles": int(steady_recompiles),
+        "warmup": {"compiles": int(warm_compiles),
+                   "seconds": round(warm_s, 3),
+                   "buckets": len(engine.warmed_buckets)},
+        "hot_swap": {"requests_served": swap_served,
+                     "failed_requests": len(swap_failures)},
+        "request_errors": len(closed_err) + len(open_err),
+        "extra_metrics": [
+            {"metric": "closed_loop_p50_ms", "value": round(closed_p[50], 3)},
+            {"metric": "closed_loop_p95_ms", "value": round(closed_p[95], 3)},
+            {"metric": "closed_loop_p99_ms", "value": round(closed_p[99], 3)},
+            {"metric": "open_loop_throughput", "unit": "req/s",
+             "value": round(len(open_lat) / open_wall, 1)},
+            {"metric": "open_loop_p50_ms", "value": round(open_p[50], 3)},
+            {"metric": "open_loop_p95_ms", "value": round(open_p[95], 3)},
+            {"metric": "open_loop_p99_ms", "value": round(open_p[99], 3)},
+            {"metric": "mean_batch_occupancy_rows",
+             "value": round(occupancy.sum / max(1, occupancy.count), 2)},
+        ],
+    }
+    print(json.dumps(result))
+
+    ok = (steady_recompiles == 0 and not swap_failures
+          and not closed_err and not open_err)
+    if args.smoke and not ok:
+        print(f"SMOKE FAIL: steady_state_recompiles={steady_recompiles} "
+              f"swap_failures={swap_failures[:3]} "
+              f"closed_err={closed_err[:3]} open_err={open_err[:3]}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

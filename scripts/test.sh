@@ -20,4 +20,10 @@ bash scripts/lint.sh
 # a non-baselined finding)
 bash scripts/lint.sh --fix-check
 
+# tier-1 gate 3: serving smoke — warmup then a bucket-sweeping load must
+# show ZERO steady-state recompiles, and an in-flight hot swap must fail
+# zero requests (docs/serving.md; prints one BENCH-style JSON line)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_serving.py --smoke
+
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

@@ -1,0 +1,79 @@
+"""io/checkpoint.py interchange pins: the text model-rows (.tsv/.csv)
+format the reference's -loadmodel consumed, the npz round trip, and the
+file-handle hygiene of the np.load paths."""
+
+import gc
+
+import numpy as np
+
+from hivemall_tpu.io.checkpoint import (dense_from_rows, load_model_rows,
+                                        save_model_rows)
+
+FEATS = np.array([3, 17, 42, 100], np.int64)
+WEIGHTS = np.array([0.5, -1.25, 2.0, 0.0078125], np.float32)
+COVARS = np.array([1.0, 0.5, 0.25, 2.0], np.float32)
+
+
+def test_tsv_interchange_roundtrip(tmp_path):
+    """Write the exact Hive-exported table shape (feature<TAB>weight<TAB>
+    covar) and pin that load_model_rows parses it value-exactly — the
+    reference's LearnerBaseUDTF.loadPredictionModel file contract."""
+    path = str(tmp_path / "model.tsv")
+    with open(path, "w") as f:
+        f.write("# hive model table export\n\n")
+        for a, w, c in zip(FEATS, WEIGHTS, COVARS):
+            f.write(f"{a}\t{w}\t{c}\n")
+    feats, weights, covars = load_model_rows(path)
+    assert np.array_equal(feats, FEATS)
+    assert np.array_equal(weights, WEIGHTS)
+    assert np.array_equal(covars, COVARS)
+    assert weights.dtype == np.float32 and feats.dtype == np.int64
+
+
+def test_csv_interchange_without_covar(tmp_path):
+    path = str(tmp_path / "model.csv")
+    with open(path, "w") as f:
+        for a, w in zip(FEATS, WEIGHTS):
+            f.write(f"{a},{w}\n")
+    feats, weights, covars = load_model_rows(path)
+    assert np.array_equal(feats, FEATS)
+    assert np.array_equal(weights, WEIGHTS)
+    assert covars is None
+
+
+def test_npz_roundtrip_and_dense_reconstruction(tmp_path):
+    path = str(tmp_path / "model.npz")
+    save_model_rows(path, FEATS, WEIGHTS, COVARS)
+    feats, weights, covars = load_model_rows(path)
+    assert np.array_equal(feats, FEATS)
+    assert np.array_equal(weights, WEIGHTS)
+    assert np.array_equal(covars, COVARS)
+    w, c = dense_from_rows(128, feats, weights, covars)
+    assert w[3] == WEIGHTS[0] and w[100 % 128] == WEIGHTS[3]
+    assert c[17] == COVARS[1]
+    assert w[5] == 0.0 and c[5] == 1.0  # untouched defaults
+
+
+def test_npz_load_closes_file_handle(tmp_path):
+    """The leak fix: load_model_rows/load_linear_state must not leave the
+    NpzFile's zip handle open (one fd per reload in a long-lived scorer)."""
+    path = str(tmp_path / "model.npz")
+    save_model_rows(path, FEATS, WEIGHTS)
+    import zipfile
+
+    opened = []
+    orig_init = zipfile.ZipFile.__init__
+
+    def spy_init(self, *a, **kw):
+        opened.append(self)
+        return orig_init(self, *a, **kw)
+
+    zipfile.ZipFile.__init__ = spy_init
+    try:
+        load_model_rows(path)
+    finally:
+        zipfile.ZipFile.__init__ = orig_init
+    gc.collect()
+    assert opened, "np.load did not open a zip?"
+    assert all(z.fp is None for z in opened), \
+        "NpzFile zip handle left open — wrap np.load in a context manager"

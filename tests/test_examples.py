@@ -30,3 +30,8 @@ def test_lof_example():
 def test_text_classification_ja_example():
     out = _run("text_classification_ja.py")
     assert "tokenize_ja_bulk -> tf -> feature_hashing" in out
+
+
+def test_serve_ctr_example():
+    out = _run("serve_ctr.py")
+    assert "train -> freeze -> deploy -> predict -> hot swap: done" in out

@@ -5,6 +5,8 @@ ThroughputCounter feeding msgs/sec into the MBean)."""
 import json
 import urllib.request
 
+import pytest
+
 from hivemall_tpu.runtime.metrics import REGISTRY
 from hivemall_tpu.runtime.metrics_http import render_prometheus, serve_metrics
 
@@ -17,6 +19,50 @@ def test_render_prometheus_names_and_values():
     assert lines["hivemall_tpu_train_rows_processed"] == "42.0"
     assert lines["hivemall_tpu_mix_psum_per_sec"] == "1.5"
     assert lines["hivemall_tpu_weird_key__1"] == "2.0"
+
+
+def test_render_prometheus_typed_exposition():
+    """The registry render carries # HELP / # TYPE metadata per metric kind
+    (counter / gauge / histogram; meters surface as gauges)."""
+    REGISTRY.counter("expo", "events").increment(3)
+    REGISTRY.set_gauge("expo.level", 1.25)
+    REGISTRY.meter("expo.msgs").record(2)
+    h = REGISTRY.histogram("expo.latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = render_prometheus()
+    assert "# HELP hivemall_tpu_expo_events" in text
+    assert "# TYPE hivemall_tpu_expo_events counter" in text
+    assert "hivemall_tpu_expo_events 3.0" in text
+    assert "# TYPE hivemall_tpu_expo_level gauge" in text
+    assert "# TYPE hivemall_tpu_expo_msgs_per_sec gauge" in text
+    assert "# TYPE hivemall_tpu_expo_latency histogram" in text
+    assert 'hivemall_tpu_expo_latency_bucket{le="0.1"} 1' in text
+    assert 'hivemall_tpu_expo_latency_bucket{le="1.0"} 2' in text
+    assert 'hivemall_tpu_expo_latency_bucket{le="+Inf"} 3' in text
+    assert "hivemall_tpu_expo_latency_count 3" in text
+    assert "hivemall_tpu_expo_latency_sum 5.55" in text
+
+
+def test_histogram_snapshot_and_quantile():
+    from hivemall_tpu.runtime.metrics import Histogram
+
+    h = Histogram("t", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.6, 3.0, 100.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(106.6)
+    assert snap["buckets"] == [(1.0, 1), (2.0, 3), (4.0, 4),
+                               (float("inf"), 5)]
+    assert h.quantile(0.5) == 2.0  # 3rd of 5 falls in the <=2.0 bucket
+    # the plain snapshot() dict exports count/sum for legacy consumers
+    flat = REGISTRY.snapshot()
+    REGISTRY.histogram("flat.check").observe(1.0)
+    flat = REGISTRY.snapshot()
+    assert flat["flat.check.count"] == 1.0
 
 
 def test_live_scrape_and_health():

@@ -1,0 +1,139 @@
+"""Artifact round-trip pins (serving/artifact.py): every model family
+trains tiny, freezes, reloads, and must predict BIT-IDENTICALLY to the live
+model — the immutable-artifact contract online serving rests on."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.serving import ServingEngine, family_of, freeze, load
+
+ROWS = [[f"{i % 13}:1.0", f"{(i * 7) % 13}:0.5"] for i in range(30)]
+LABELS = [1 if i % 2 else -1 for i in range(30)]
+
+
+def _roundtrip(model, instances, live, tmp_path, tag, **engine_kw):
+    path = str(tmp_path / tag)
+    manifest = freeze(model, path, name=tag, version="1")
+    assert manifest["family"] == family_of(model)
+    assert manifest["sha256"]
+    art = load(path)
+    assert art.family == manifest["family"]
+    eng = ServingEngine(art, name=f"art_{tag}", max_batch=16, max_width=16,
+                        **engine_kw)
+    served = eng.predict(instances)
+    if isinstance(live, np.ndarray):
+        assert np.array_equal(live, np.asarray(served)), \
+            f"{tag}: served != live"
+    else:
+        assert list(live) == list(served), f"{tag}: served != live"
+    return manifest
+
+
+def test_linear_roundtrip(tmp_path):
+    from hivemall_tpu.models.classifier import train_arow
+
+    m = train_arow(ROWS, LABELS, "-dims 256")
+    man = _roundtrip(m, ROWS, m.predict(ROWS), tmp_path, "linear")
+    assert man["meta"]["rule"] == "arow"
+    assert man["meta"]["use_covariance"] is True
+    # the linear payload IS the io/checkpoint interchange layout
+    assert man["meta"]["columns"] == ["feature", "weight", "covar"]
+
+
+def test_linear_no_covar_roundtrip(tmp_path):
+    from hivemall_tpu.models.classifier import train_perceptron
+
+    m = train_perceptron(ROWS, LABELS, "-dims 256")
+    man = _roundtrip(m, ROWS, m.predict(ROWS), tmp_path, "perceptron")
+    assert man["meta"]["columns"] == ["feature", "weight"]
+
+
+def test_multiclass_roundtrip(tmp_path):
+    from hivemall_tpu.models.multiclass import train_multiclass_pa
+
+    labels = ["a", "b", "c"] * 10
+    m = train_multiclass_pa(ROWS, labels, "-dims 128")
+    _roundtrip(m, ROWS, m.predict(ROWS), tmp_path, "mc")
+
+
+def test_fm_roundtrip(tmp_path):
+    from hivemall_tpu.models.fm import train_fm
+
+    m = train_fm(ROWS, [float(v) for v in LABELS], "-p 128 -factor 3")
+    _roundtrip(m, ROWS, m.predict(ROWS), tmp_path, "fm")
+
+
+def test_ffm_roundtrip(tmp_path):
+    from hivemall_tpu.models.ffm import train_ffm
+
+    frows = [[f"{i % 3}:{i % 11}:1.0", f"{(i + 1) % 3}:{(i * 5) % 11}:0.5"]
+             for i in range(30)]
+    m = train_ffm(frows, LABELS, "-feature_hashing 8 -v_bits 10 -factor 2")
+    _roundtrip(m, frows, m.predict(frows), tmp_path, "ffm")
+
+
+def test_mf_roundtrip(tmp_path):
+    from hivemall_tpu.models.mf import train_mf_sgd
+
+    users = [i % 5 for i in range(40)]
+    items = [(i * 3) % 7 for i in range(40)]
+    ratings = [float((i % 5) + 1) for i in range(40)]
+    m = train_mf_sgd(users, items, ratings)
+    pairs = list(zip(users[:10], items[:10]))
+    _roundtrip(m, pairs, m.predict(users[:10], items[:10]), tmp_path, "mf")
+
+
+def test_forest_roundtrip(tmp_path):
+    from hivemall_tpu.models.trees.forest import train_randomforest_classifier
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(60, 4)
+    y = (X[:, 0] + X[:, 1] > 1).astype(int)
+    m = train_randomforest_classifier(X, y, "-trees 5 -seed 1")
+    _roundtrip(m, X[:20].tolist(), m.predict(X[:20]), tmp_path, "forest")
+
+
+def test_gbt_roundtrip(tmp_path):
+    from hivemall_tpu.models.trees.forest import \
+        train_gradient_tree_boosting_classifier
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(60, 4)
+    y = (X[:, 0] + X[:, 1] > 1).astype(int)
+    m = train_gradient_tree_boosting_classifier(X, y, "-trees 3 -seed 1")
+    _roundtrip(m, X[:20].tolist(), m.predict(X[:20]), tmp_path, "gbt")
+
+
+def test_artifacts_are_immutable(tmp_path):
+    from hivemall_tpu.models.classifier import train_perceptron
+
+    m = train_perceptron(ROWS, LABELS, "-dims 128")
+    path = str(tmp_path / "v1")
+    freeze(m, path)
+    with pytest.raises(FileExistsError):
+        freeze(m, path)
+
+
+def test_corrupt_artifact_detected(tmp_path):
+    from hivemall_tpu.models.classifier import train_perceptron
+    from hivemall_tpu.serving.artifact import ARRAYS_FILE
+
+    m = train_perceptron(ROWS, LABELS, "-dims 128")
+    path = str(tmp_path / "v1")
+    freeze(m, path)
+    with open(os.path.join(path, ARRAYS_FILE), "ab") as f:
+        f.write(b"tamper")
+    with pytest.raises(ValueError, match="sha256"):
+        load(path)
+    load(path, verify=False)  # explicit opt-out still works
+
+
+def test_live_model_served_without_freezing(tmp_path):
+    """make_servable accepts the trained object directly (bench path)."""
+    from hivemall_tpu.models.classifier import train_arow
+
+    m = train_arow(ROWS, LABELS, "-dims 256")
+    eng = ServingEngine(m, name="live_direct", max_batch=16, max_width=16)
+    assert np.array_equal(m.predict(ROWS), np.asarray(eng.predict(ROWS)))

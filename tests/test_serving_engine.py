@@ -1,0 +1,90 @@
+"""Serving engine pins (serving/engine.py): shape bucketing, warmup
+precompilation, and the zero-steady-state-recompile contract witnessed by
+runtime.metrics.recompile_guard — the G001 discipline applied to inference."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models.classifier import train_arow
+from hivemall_tpu.runtime.metrics import REGISTRY, recompile_guard
+from hivemall_tpu.serving import ServingEngine
+
+ROWS = [[f"{i % 13}:1.0", f"{(i * 7) % 13}:0.5"] for i in range(64)]
+LABELS = [1 if i % 2 else -1 for i in range(64)]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return train_arow(ROWS, LABELS, "-dims 256")
+
+
+def test_bucket_lists(model):
+    eng = ServingEngine(model, name="eng_buckets", max_batch=64, max_width=32)
+    assert eng.batch_buckets() == [8, 16, 32, 64]
+    assert eng.width_buckets() == [8, 16, 32]
+    assert eng.bucket_batch(1) == 8
+    assert eng.bucket_batch(9) == 16
+    assert eng.bucket_batch(1000) == 64  # capped; engine chunks instead
+
+
+def test_warmup_covers_every_bucket_then_zero_recompiles(model):
+    eng = ServingEngine(model, name="eng_warm", max_batch=32, max_width=16)
+    eng.warmup()
+    assert len(eng.warmed_buckets) == \
+        len(eng.batch_buckets()) * len(eng.width_buckets())
+    # second warmup is free: everything already compiled
+    assert eng.warmup() == 0
+
+    # sweep EVERY bucket combination: request sizes and row widths that
+    # land in each batch/width bucket must hit the warm cache only
+    before = REGISTRY.counter("graftcheck", "recompiles.serving.eng_warm").value
+    with recompile_guard("eng_warm_sweep", *eng.servable.jit_fns,
+                         expect_stable=True):
+        for n in (1, 7, 8, 9, 16, 30, 32):
+            for width in (1, 5, 8, 13, 16):
+                batch = [[f"{k % 13}:1.0" for k in range(width)]
+                         for _ in range(n)]
+                out = eng.predict(batch)
+                assert len(out) == n
+    after = REGISTRY.counter("graftcheck", "recompiles.serving.eng_warm").value
+    assert after == before, "steady-state serving recompiled"
+
+
+def test_requests_larger_than_max_batch_chunk(model):
+    eng = ServingEngine(model, name="eng_chunk", max_batch=16, max_width=16)
+    out = eng.predict(ROWS)  # 64 rows through a 16-row engine
+    assert np.array_equal(np.asarray(out), model.predict(ROWS))
+
+
+def test_overwide_rows_truncate_and_count(model):
+    eng = ServingEngine(model, name="eng_trunc", max_batch=16, max_width=8)
+    # one overwide row riding with two normal rows: the counter must count
+    # ROWS that truncate, not the whole chunk
+    batch = [[f"{k % 13}:1.0" for k in range(20)],  # 20 nnz > max_width 8
+             ROWS[0], ROWS[1]]
+    before = REGISTRY.counter("serving", "eng_trunc.truncated_rows").value
+    out = eng.predict(batch)
+    assert len(out) == 3
+    assert REGISTRY.counter("serving",
+                            "eng_trunc.truncated_rows").value == before + 1
+
+
+def test_empty_request(model):
+    eng = ServingEngine(model, name="eng_empty", max_batch=16, max_width=8)
+    assert eng.predict([]) == []
+
+
+def test_latency_histogram_records(model):
+    eng = ServingEngine(model, name="eng_hist", max_batch=16, max_width=16)
+    eng.predict(ROWS[:4])
+    h = REGISTRY.histogram("serving.eng_hist.predict_seconds")
+    assert h.snapshot()["count"] >= 1
+
+
+def test_padding_rows_do_not_leak_into_results(model):
+    """A size-1 request pads to the 8-row bucket; the 7 padding rows must
+    not change the one real score."""
+    eng = ServingEngine(model, name="eng_pad", max_batch=32, max_width=16)
+    one = eng.predict(ROWS[:1])
+    many = eng.predict(ROWS[:32])
+    assert np.asarray(one)[0] == np.asarray(many)[0]
