@@ -79,9 +79,11 @@ def main(argv: List[str] = None) -> int:
         prog="python -m hivemall_tpu.analysis",
         description="graftcheck: JAX/TPU-aware static analysis "
                     "(recompile / host-sync / dtype / axis / donation / "
-                    "side-effect hazards, plus interprocedural SPMD/"
-                    "collective safety G007-G011 with a --fix autofix "
-                    "engine)")
+                    "side-effect hazards, interprocedural SPMD/collective "
+                    "safety G007-G011, and concurrency/serving safety "
+                    "G012-G016 — lock discipline, blocking-under-lock, CV "
+                    "misuse, thread leaks, lock-order cycles — with a "
+                    "--fix autofix engine and SARIF output)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: hivemall_tpu)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -92,7 +94,10 @@ def main(argv: List[str] = None) -> int:
                     help="accept the current findings as the new baseline")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule subset (e.g. G001,G002)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
+                    help="sarif emits SARIF 2.1.0 of the non-baselined "
+                         "findings for CI annotations")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--fix", action="store_true",
                     help="apply machine-applicable fixes (with a unified-"
@@ -162,7 +167,10 @@ def main(argv: List[str] = None) -> int:
         new, stale = diff_against_baseline(findings, load_baseline(
             args.baseline), scanned_paths=scanned)
 
-    if args.format == "json":
+    if args.format == "sarif":
+        from .sarif import render_sarif
+        print(json.dumps(render_sarif(new), indent=1))
+    elif args.format == "json":
         print(json.dumps({
             "new": [f.to_dict() for f in new],
             "stale": [f.to_dict() for f in stale],

@@ -76,6 +76,46 @@ DEFAULT_AXIS_NAMES = frozenset({"workers", "shards"})
 COLLECTIVE_CALLS = ("psum", "pmean", "pmax", "pmin", "all_gather",
                     "axis_index", "ppermute", "psum_scatter", "pcast")
 
+# --- G012-G016: concurrency / serving safety --------------------------------
+# Constructors whose result is a lock object; the kind decides reentrancy
+# (plain Lock is non-reentrant; Condition() wraps an RLock by default).
+LOCK_CONSTRUCTOR_KINDS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+}
+
+# Method calls on a field that mutate the underlying container — counted as
+# WRITES by the guarded-by inference (`self._q.append(x)` races exactly like
+# `self._q = ...`).
+MUTATOR_METHODS = ("append", "appendleft", "extend", "insert", "add",
+                   "discard", "remove", "clear", "update", "setdefault",
+                   "pop", "popleft", "popitem", "sort")
+
+# G013 scope: the serving hot path — a blocking call under a lock here stalls
+# every in-flight request at once (the hot-swap-stall failure mode). Modules
+# outside the list opt in with the marker comment.
+CONCURRENCY_HOT_PREFIXES = ("hivemall_tpu/serving/",
+                            "hivemall_tpu/runtime/metrics")
+CONCURRENCY_MARKER = "# graftcheck: serving-module"
+
+# Blocking-call classification for G013 (tails of dotted callees).
+BLOCKING_DEVICE_TAILS = ("device_get", "block_until_ready")
+BLOCKING_IO_TAILS = ("sleep", "urlopen", "connect", "accept", "recv",
+                     "sendall", "getaddrinfo", "fsync")
+# Future/thread rendezvous: .result() blocks on completion; set_result /
+# set_exception run done-callbacks synchronously on the calling thread.
+BLOCKING_FUTURE_TAILS = ("result", "set_result", "set_exception", "join",
+                         "wait")
+# jit dispatch / compile triggers: a cold bucket compiles under the lock.
+BLOCKING_JIT_TAILS = ("warmup", "predict", "predict_fn")
+# Roots whose methods share tails with the blocking list but never block
+# (os.path.join, np ops, json/re parsing).
+BLOCKING_SAFE_ROOTS = ("os", "np", "numpy", "json", "re", "posixpath",
+                       "ntpath", "shutil", "sys", "math")
+
 # --- G005: donation --------------------------------------------------------
 # jit-wrapped functions whose name looks step-shaped should donate their
 # model-state argument; otherwise every hot-loop step copies the tables.

@@ -107,17 +107,23 @@ class DynamicBatcher:
         """Stop accepting work. ``drain=True`` (the hot-swap path) lets the
         worker finish everything already queued before the thread exits, so
         an in-flight version swap fails zero requests."""
+        dropped: List[_Pending] = []
         with self._cv:
             if self._closed:
                 return
             self._closed = True
             if not drain:
                 while self._q:
-                    p = self._q.popleft()
-                    p.future.set_exception(
-                        BatcherClosed(f"batcher {self.name!r} closed"))
+                    dropped.append(self._q.popleft())
                 self._depth_rows = 0
             self._cv.notify_all()
+        # outside the lock: set_exception runs done-callbacks synchronously,
+        # and arbitrary callback code must never execute while _cv is held
+        # (a callback that needs the lock would stall every producer — the
+        # G013 blocking-under-lock hazard)
+        for p in dropped:
+            p.future.set_exception(
+                BatcherClosed(f"batcher {self.name!r} closed"))
         self._thread.join(timeout=30.0)
 
     # -- worker side ---------------------------------------------------------

@@ -10,6 +10,12 @@ the same objects the /predict endpoint drives, minus HTTP parse noise):
   (the coordinated-omission-free latency probe — queueing delay shows up in
   the numbers instead of silently throttling the load generator).
 
+`--http` switches to the end-to-end surface instead: a ModelRegistry +
+`serving.serve()` endpoint is stood up in-process and the closed loop and
+hot-swap probe drive `POST /predict` over real sockets — HTTP parse, JSON
+(de)serialization, and handler threading included — reporting the same
+BENCH-style JSON (methodology `http_post_predict_closed_loop`).
+
 Verifies the two serving invariants while measuring:
 - after warmup, a request sweep spanning every shape bucket leaves the
   `graftcheck.recompiles.serving.*` counter FLAT (zero steady-state
@@ -28,6 +34,8 @@ import json
 import sys
 import threading
 import time
+import urllib.error
+import urllib.request
 
 import numpy as np
 
@@ -178,32 +186,190 @@ def hot_swap_probe(model_factory, batcher_kw, engine_kw, pool,
     return len(served), failures
 
 
+def _http_post(port, payload, timeout=60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def http_closed_loop(port, pool, concurrency: int, model: str = "bench"):
+    """Closed loop over POST /predict — the same probe as closed_loop()
+    but end-to-end: sockets, HTTP parse, JSON, handler threads."""
+    lat, errors = [], []
+    lock = threading.Lock()
+    it = iter(pool)
+
+    def worker():
+        while True:
+            with lock:
+                req = next(it, None)
+            if req is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                out = _http_post(port, {"model": model, "instances": req})
+                if len(out["predictions"]) != len(req):
+                    raise RuntimeError("prediction count mismatch")
+            except Exception as e:  # 5xx surfaces as HTTPError: an error
+                with lock:
+                    errors.append(repr(e))
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                lat.append(dt)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return lat, wall, errors
+
+
+def http_hot_swap_probe(registry, port, model_factory, pool,
+                        concurrency: int):
+    """Hammer POST /predict while deploying v2 over v1; a swap must fail
+    zero requests at the HTTP surface too (503s included)."""
+    served, failures = [], []
+    versions = set()
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def hammer(i):
+        j = 0
+        while not stop.is_set():
+            try:
+                out = _http_post(port, {"model": "bench",
+                                        "instances":
+                                            pool[(i * 31 + j) % len(pool)]})
+                with lock:
+                    served.append(1)
+                    versions.add(out["version"])
+            except Exception as e:
+                with lock:
+                    failures.append(repr(e))
+            j += 1
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    registry.deploy("bench", model_factory(2), version="2")
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    return len(served), failures, versions
+
+
+def run_http_mode(args, source, rows, tag) -> int:
+    from hivemall_tpu.serving import ModelRegistry
+    from hivemall_tpu.serving.server import serve
+
+    registry = ModelRegistry(
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+        engine_kwargs={"max_batch": args.max_batch,
+                       "max_width": args.max_width})
+    t0 = time.perf_counter()
+    registry.deploy("bench", source, version="1")  # warms every bucket
+    warm_s = time.perf_counter() - t0
+    server = serve(registry)
+    port = server.server_address[1]
+    snap = REGISTRY.snapshot()
+    warm_compiles = int(snap.get("serving.bench.warmup_compiles", 0))
+    pool = _request_pool(rows, args.requests, args.instances_per_request)
+    guard = REGISTRY.counter("graftcheck", "recompiles.serving.bench")
+
+    recompiles0 = guard.value
+    lat, wall, errors = http_closed_loop(port, pool, args.concurrency)
+    steady_recompiles = guard.value - recompiles0
+    p = _percentiles(lat) if lat else {50: 0, 95: 0, 99: 0}
+
+    def factory(v):
+        return _train_default(args.dims, args.train_rows, seed=v)[0]
+
+    swap_served, swap_failures, versions = http_hot_swap_probe(
+        registry, port, factory, pool, args.concurrency)
+    server.shutdown()
+    registry.shutdown()
+
+    result = {
+        "metric": f"serving_http_closed_loop_throughput_{tag}",
+        "value": round(len(lat) / wall, 1) if wall else 0.0,
+        "unit": "req/s",
+        "methodology": "http_post_predict_closed_loop",
+        "steady_state_recompiles": int(steady_recompiles),
+        "warmup": {"compiles": warm_compiles,
+                   "seconds": round(warm_s, 3)},
+        "hot_swap": {"requests_served": swap_served,
+                     "failed_requests": len(swap_failures),
+                     "versions_observed": sorted(versions)},
+        "request_errors": len(errors),
+        "extra_metrics": [
+            {"metric": "http_p50_ms", "value": round(p[50], 3)},
+            {"metric": "http_p95_ms", "value": round(p[95], 3)},
+            {"metric": "http_p99_ms", "value": round(p[99], 3)},
+        ],
+    }
+    print(json.dumps(result))
+
+    ok = (steady_recompiles == 0 and not swap_failures and not errors
+          and {"1", "2"} <= versions)
+    if args.smoke and not ok:
+        print(f"SMOKE FAIL: steady_state_recompiles={steady_recompiles} "
+              f"swap_failures={swap_failures[:3]} errors={errors[:3]} "
+              f"versions={sorted(versions)}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--artifact", help="serve this artifact dir instead of "
                                        "training a tiny AROW model")
-    ap.add_argument("--dims", type=int, default=1 << 16)
-    ap.add_argument("--train-rows", type=int, default=2000)
-    ap.add_argument("--requests", type=int, default=2000)
+    # sizing flags default to None so --smoke can tell "left unset" from
+    # "explicitly passed the full-size value"; resolved below
+    ap.add_argument("--dims", type=int, default=None,
+                    help="default 65536 (1024 under --smoke)")
+    ap.add_argument("--train-rows", type=int, default=None,
+                    help="default 2000 (300 under --smoke)")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="default 2000 (300 under --smoke)")
     ap.add_argument("--instances-per-request", type=int, default=8)
-    ap.add_argument("--concurrency", type=int, default=8)
-    ap.add_argument("--rate", type=float, default=500.0,
-                    help="open-loop arrival rate, req/s")
-    ap.add_argument("--max-batch", type=int, default=256)
-    ap.add_argument("--max-width", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=None,
+                    help="default 8 (4 under --smoke)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrival rate, req/s; default 500 "
+                         "(300 under --smoke)")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="default 256 (64 under --smoke)")
+    ap.add_argument("--max-width", type=int, default=None,
+                    help="default 64 (32 under --smoke)")
     ap.add_argument("--max-delay-ms", type=float, default=2.0)
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale run; exit non-zero on any "
                          "invariant violation (scripts/test.sh gate)")
+    ap.add_argument("--http", action="store_true",
+                    help="drive POST /predict end-to-end (registry + HTTP "
+                         "endpoint in-process) instead of calling the "
+                         "engine directly")
     args = ap.parse_args()
-    if args.smoke:
-        args.dims = 1 << 10
-        args.train_rows = 300
-        args.requests = 300
-        args.concurrency = 4
-        args.rate = 300.0
-        args.max_batch = 64
-        args.max_width = 32
+    # resolve the sentinel defaults: full-size normally, seconds-scale
+    # under --smoke; an explicitly-passed flag always wins, even when its
+    # value coincides with a default
+    sizing = {"dims": (1 << 16, 1 << 10), "train_rows": (2000, 300),
+              "requests": (2000, 300), "concurrency": (8, 4),
+              "rate": (500.0, 300.0), "max_batch": (256, 64),
+              "max_width": (64, 32)}
+    for name, (full, small) in sizing.items():
+        if getattr(args, name) is None:
+            setattr(args, name, small if args.smoke else full)
 
     if args.artifact:
         source = load(args.artifact)
@@ -213,6 +379,13 @@ def main() -> int:
         model, rows = _train_default(args.dims, args.train_rows)
         source = model
         tag = f"arow_{args.dims}dims"
+
+    if args.http:
+        if rows is None:
+            raise SystemExit("--http benching needs a request generator "
+                             "for the artifact family; only the default "
+                             "AROW flow ships one")
+        return run_http_mode(args, source, rows, tag)
 
     engine_kw = {"max_batch": args.max_batch, "max_width": args.max_width}
     engine = ServingEngine(source, name="bench", **engine_kw)

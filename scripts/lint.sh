@@ -4,8 +4,10 @@
 #   scripts/lint.sh              # changed-files mode (~5s): files touched vs
 #                                # HEAD (staged + unstaged + untracked), PLUS
 #                                # the modules that import them — the
-#                                # interprocedural rules (G007-G011) can fire
-#                                # in an unchanged caller whose callee changed
+#                                # interprocedural rules (SPMD safety
+#                                # G007-G011 and concurrency/serving safety
+#                                # G012-G016) can fire in an unchanged caller
+#                                # whose callee changed
 #   scripts/lint.sh --all        # full-tree scan of hivemall_tpu/
 #   scripts/lint.sh --fix-check  # fail if `--fix` would diff the changed
 #                                # files; combine with --all for full-tree

@@ -1,0 +1,75 @@
+"""G012 negative fixture: consistent guarding (directly and through a
+locked helper), init-only publish fields, and single-threaded classes —
+zero findings."""
+
+import threading
+
+
+class Guarded:
+    """Every touch of _q/_closed happens under the condition variable."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._q = []
+        self._closed = False
+
+    def put(self, item):
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("closed")
+            self._q.append(item)
+            self._cv.notify()
+
+    def size(self):
+        with self._cv:
+            return len(self._q)
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+class HelperGuarded:
+    """_n is only touched in a private helper that every caller enters
+    with the lock held: guarded through context propagation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._n += 1
+
+    def read(self):
+        with self._lock:
+            return self._n
+
+
+class PublishOnly:
+    """Fields written only at construction are immutable-after-publish;
+    bare reads are safe."""
+
+    def __init__(self, fn):
+        self._lock = threading.Lock()
+        self.fn = fn
+        self.calls = 0
+
+    def work(self):
+        with self._lock:
+            self.calls += 1
+        return self.fn()
+
+
+class SingleThreaded:
+    """No lock, no spawned thread, no handler methods: out of scope."""
+
+    def __init__(self):
+        self.x = 0
+
+    def inc(self):
+        self.x += 1

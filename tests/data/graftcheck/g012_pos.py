@@ -1,0 +1,55 @@
+"""G012 positive fixture: shared mutable fields with no consistent lock —
+the inconsistent-discipline case and the cross-thread no-lock case."""
+
+import threading
+
+
+class MixedGuard:
+    """_count is written under the lock in one method, read bare in
+    another: the read races with the locked writer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def peek(self):
+        return self._count  # EXPECT: G012
+
+
+class DisjointLocks:
+    """Every access is locked — but by two different locks, which do not
+    exclude each other."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._a:
+            self._n += 1
+
+    def peek(self):
+        with self._b:
+            return self._n  # EXPECT: G012
+
+
+class CrossThread:
+    """No lock anywhere: the spawned worker writes, callers read."""
+
+    def __init__(self):
+        self.total = 0
+        self._stop = False
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _loop(self):
+        while not self._stop:
+            self.total += 1  # EXPECT: G012
+
+    def read(self):
+        return self.total

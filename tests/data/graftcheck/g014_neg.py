@@ -1,0 +1,45 @@
+"""G014 negative fixture: predicate-loop waits, held notifies, reentrant
+re-acquire (RLock), and wait_for — zero findings."""
+
+import threading
+
+
+class GoodCV:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait()
+
+    def wait_ready_deadline(self, deadline):
+        with self._cv:
+            while not self._ready:
+                self._cv.wait(timeout=deadline)
+
+    def wait_ready_predicate(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self._ready)
+
+    def set_ready(self):
+        with self._cv:
+            self._ready = True
+            self._cv.notify_all()
+
+
+class ReentrantHelper:
+    """RLock: re-acquiring through a helper is legal by construction."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._n = 0
+
+    def outer(self):
+        with self._lock:
+            self._inner()
+
+    def _inner(self):
+        with self._lock:
+            self._n += 1

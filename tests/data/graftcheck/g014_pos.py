@@ -1,0 +1,35 @@
+"""G014 positive fixture: wait outside a predicate loop (machine-fixable),
+notify without the CV held, and a non-reentrant lock re-acquired through
+a helper."""
+
+import threading
+
+
+class BadWait:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._ready = False
+
+    def wait_ready(self):
+        with self._cv:
+            if not self._ready:
+                self._cv.wait()  # EXPECT: G014
+
+    def set_ready(self):
+        with self._cv:
+            self._ready = True
+        self._cv.notify_all()  # EXPECT: G014
+
+
+class DoubleAcquire:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def outer(self):
+        with self._lock:
+            self._inner()  # EXPECT: G014
+
+    def _inner(self):
+        with self._lock:
+            self._n += 1

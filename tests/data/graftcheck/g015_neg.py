@@ -1,0 +1,48 @@
+"""G015 negative fixture: daemon threads, joined threads (directly, via
+a collected list, on a shutdown path), and escaping thread objects —
+zero findings."""
+
+import threading
+
+
+def daemon_worker(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+
+
+def run_and_wait(work):
+    t = threading.Thread(target=work)
+    t.start()
+    t.join(timeout=30.0)
+
+
+def fan_out_join(work, n):
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def collect_then_join(work, n):
+    threads = []
+    for _ in range(n):
+        t = threading.Thread(target=work)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+
+
+def handed_to_caller(work):
+    t = threading.Thread(target=work)
+    return t  # escapes: the caller owns the join
+
+
+class JoinedOnClose:
+    def __init__(self, work):
+        self._t = threading.Thread(target=work)
+        self._t.start()
+
+    def close(self):
+        self._t.join(timeout=30.0)

@@ -1,0 +1,25 @@
+"""G015 positive fixture: non-daemon threads that are never joined —
+fire-and-forget locals (machine-fixable), anonymous starts, and a stored
+worker with no join on any shutdown path."""
+
+import threading
+
+
+def fire_and_forget(work):
+    t = threading.Thread(target=work)  # EXPECT: G015
+    t.start()
+
+
+def anonymous_start(work):
+    threading.Thread(target=work).start()  # EXPECT: G015
+
+
+class Leaky:
+    def __init__(self, work):
+        self._t = threading.Thread(  # EXPECT: G015
+            target=work,
+            name="leaky-worker")
+        self._t.start()
+
+    def poke(self):
+        return self._t.is_alive()

@@ -1,0 +1,55 @@
+"""G016 negative fixture: one consistent lock order, cross-lock calls
+made after releasing, and unresolvable receivers (trusted) — zero
+findings."""
+
+import threading
+
+
+class Front:
+    """Always acquires front -> back, never the reverse."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def ingest(self):
+        with self._lock:
+            BACK.store()
+
+    def drop(self):
+        with self._lock:
+            BACK.store()
+
+    def touch(self, snapshot):
+        with self._lock:
+            return snapshot
+
+
+class Back:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def store(self):
+        with self._lock:
+            return "stored"
+
+    def refresh(self):
+        # calls back into Front, but only AFTER releasing: no reverse edge
+        with self._lock:
+            snapshot = "x"
+        return FRONT.touch(snapshot)
+
+
+class Dynamic:
+    """The peer's type is a constructor parameter: trusted."""
+
+    def __init__(self, peer):
+        self._lock = threading.Lock()
+        self._peer = peer
+
+    def poke(self):
+        with self._lock:
+            self._peer.flush()
+
+
+FRONT = Front()
+BACK = Back()

@@ -1,0 +1,34 @@
+"""G016 positive fixture: ABBA lock-ordering cycle across two classes
+reached through module-level singletons (the registry/batcher shape)."""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def swap(self):
+        with self._lock:
+            BATCHER.flush()  # EXPECT: G016
+
+    def describe(self):
+        with self._lock:
+            return "ok"
+
+
+class Batcher:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def flush(self):
+        with self._cv:
+            return None
+
+    def pump(self):
+        with self._cv:
+            REGISTRY.describe()  # EXPECT: G016
+
+
+REGISTRY = Registry()
+BATCHER = Batcher()

@@ -148,6 +148,58 @@ def test_close_without_drain_fails_pending():
     assert not closer.is_alive()
 
 
+def test_close_runs_done_callbacks_outside_the_cv():
+    """G013 regression (graftcheck v3 dogfood): close(drain=False) must set
+    Future exceptions — and thereby run done-callbacks — OUTSIDE the
+    batcher condition variable. Before the fix the closing thread held
+    `_cv` while the callback ran, so any callback needing the lock (a
+    retry-submit, a metrics hook) stalled every producer; here the
+    callback proves the lock is free by acquiring it from a fresh
+    thread."""
+    started = threading.Event()
+    release = threading.Event()
+
+    def slow_predict(instances):
+        started.set()
+        release.wait(timeout=10)
+        return instances
+
+    b = DynamicBatcher(slow_predict, name="bt_cb_unlock", max_batch=1,
+                       max_delay_ms=0.1)
+    first = b.submit([1])
+    started.wait(timeout=5)
+    queued = b.submit([2])  # stays queued: the worker is blocked in predict
+
+    cv_free = []
+    probed = threading.Event()
+
+    def on_done(_f):
+        # probe from a thread that does NOT own the (reentrant) lock: with
+        # the fix _cv is free here; before it, the closing thread held it
+        def probe():
+            got = b._cv.acquire(timeout=1.0)
+            if got:
+                b._cv.release()
+            cv_free.append(got)
+            probed.set()
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout=5)
+
+    queued.add_done_callback(on_done)
+    closer = threading.Thread(target=lambda: b.close(drain=False),
+                              daemon=True)
+    closer.start()
+    assert probed.wait(timeout=5), "done-callback never ran"
+    release.set()
+    closer.join(timeout=10)
+    assert cv_free == [True], "callback observed _cv still held by close()"
+    with pytest.raises(BatcherClosed):
+        queued.result(timeout=1)
+    assert first.result(timeout=10) == [1]
+
+
 def test_empty_submit_resolves_immediately():
     b, _ = _echo_batcher("bt_empty", max_batch=2, max_delay_ms=0.1)
     try:

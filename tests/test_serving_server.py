@@ -3,6 +3,9 @@ error codes, and the acceptance property — an in-flight v1 -> v2 hot swap
 completes with ZERO failed requests."""
 
 import json
+import os
+import subprocess
+import sys
 import threading
 import urllib.error
 import urllib.request
@@ -156,6 +159,30 @@ def test_undeploy(stack):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(port, {"model": "ctr", "instances": ROWS[:1]})
     assert e.value.code == 404
+
+
+def test_bench_serving_http_mode_smoke():
+    """scripts/bench_serving.py --http drives POST /predict end-to-end
+    (ROADMAP open item): same BENCH-style JSON, zero steady-state
+    recompiles and a zero-failure hot swap at the HTTP surface."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run(
+        [sys.executable, "scripts/bench_serving.py", "--http", "--smoke",
+         "--requests", "80", "--train-rows", "150", "--concurrency", "2"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    result = json.loads(line)
+    assert result["methodology"] == "http_post_predict_closed_loop"
+    assert result["unit"] == "req/s" and result["value"] > 0
+    assert result["steady_state_recompiles"] == 0
+    assert result["hot_swap"]["failed_requests"] == 0
+    assert set(result["hot_swap"]["versions_observed"]) == {"1", "2"}
+    assert result["request_errors"] == 0
+    assert {m["metric"] for m in result["extra_metrics"]} == {
+        "http_p50_ms", "http_p95_ms", "http_p99_ms"}
 
 
 def test_multi_model_registry(stack):
