@@ -45,6 +45,7 @@ from ..core.engine import DELTA_SLOT, Rule, make_train_fn
 from ..core.state import LinearState, init_linear_state
 from .mesh import WORKER_AXIS, make_mesh
 from ..runtime.jax_compat import shard_map
+from ..runtime.tracing import TRACER
 
 
 def mix_average(weights, delta_upd, axis_name: str = WORKER_AXIS):
@@ -425,13 +426,18 @@ class MixTrainer:
 
     def step(self, state: LinearState, indices, values, labels):
         """One mixed step. indices/values/labels: [n_dev, k, B, ...] — each
-        device consumes k blocks then the replicas mix."""
-        return self._step(state, indices, values, labels)
+        device consumes k blocks then the replicas mix. The dispatch runs
+        under a ``train.compiled_step`` span: inside a driver's
+        ``tracing.step_span`` it becomes the per-step timeline's
+        compiled-step stage (runtime/tracing.py)."""
+        with TRACER.span("train.compiled_step", args={"trainer": "mix_dp"}):
+            return self._step(state, indices, values, labels)
 
     def shard_blocks(self, indices, values, labels):
         """Host helper: split [n_dev * k, B, ...] host blocks into the
         [n_dev, k, B, ...] layout."""
-        return split_replica_blocks(self.n_dev, indices, values, labels)
+        with TRACER.span("train.data_prep", args={"trainer": "mix_dp"}):
+            return split_replica_blocks(self.n_dev, indices, values, labels)
 
     def collapse_host(self, host: LinearState) -> LinearState:
         """Collapse a host-side replicated state (see
@@ -452,4 +458,6 @@ class MixTrainer:
     def final_state(self, state: LinearState) -> LinearState:
         """Collapse the device axis after the trailing mix into one model a
         warm restart can resume from — see collapse_host."""
-        return self.collapse_host(jax.device_get(state))
+        with TRACER.span("train.sync", args={"trainer": "mix_dp"}):
+            host = jax.device_get(state)
+        return self.collapse_host(host)

@@ -57,6 +57,7 @@ from .mix import (MixConfig, collapse_linear_replicas, grouped_mix_scan,
                   make_linear_mix, replicate_state, split_replica_blocks)
 from .sharded import stripe_score
 from ..runtime.jax_compat import shard_map
+from ..runtime.tracing import TRACER
 
 
 def _resolve_1d_mesh(mesh: Optional[Mesh], who: str):
@@ -183,13 +184,21 @@ class ShardedTrainer:
 
     def step(self, state: LinearState, indices, values, labels):
         """One sharded train step. indices/values: [B, K]; labels: [B]
-        (replicated to every device — the model is what's sharded)."""
-        return self._step(state, indices, values, labels)
+        (replicated to every device — the model is what's sharded). The
+        dispatch runs under a ``train.compiled_step`` span: inside a
+        driver's ``tracing.step_span`` it becomes the per-step timeline's
+        compiled-step stage (data-prep and sync are the caller's stages —
+        see runtime/tracing.py)."""
+        with TRACER.span("train.compiled_step",
+                         args={"trainer": "sharded_1d"}):
+            return self._step(state, indices, values, labels)
 
     def final_state(self, state: LinearState) -> LinearState:
         """Host-side copy with the padding sliced back off — a plain [dims]
         model for export / warm start / init_linear_state round trips."""
-        return _unpad_state(jax.device_get(state), self.dims,
+        with TRACER.span("train.sync", args={"trainer": "sharded_1d"}):
+            host = jax.device_get(state)
+        return _unpad_state(host, self.dims,
                             self.dims_padded, self._specs, self.axis)
 
     def make_predict(self):
@@ -261,11 +270,15 @@ class FMShardedTrainer:
             # np.shape reads the .shape attribute — no device->host copy of
             # the labels block on the per-step path (graftcheck G002)
             va = np.zeros(np.shape(labels), np.float32)
-        return self._step(state, indices, values, labels, va)
+        with TRACER.span("train.compiled_step",
+                         args={"trainer": "fm_sharded"}):
+            return self._step(state, indices, values, labels, va)
 
     def final_state(self, state):
         """Host-side copy with the padding sliced back off."""
-        return _unpad_state(jax.device_get(state), self.dims,
+        with TRACER.span("train.sync", args={"trainer": "fm_sharded"}):
+            host = jax.device_get(state)
+        return _unpad_state(host, self.dims,
                             self.dims_padded, self._specs, self.axis)
 
     def make_predict(self):
@@ -380,7 +393,9 @@ class FFMShardedTrainer:
 
     def step(self, state, indices, values, fields, labels):
         """indices/values/fields: [B, K]; labels: [B] (replicated)."""
-        return self._step(state, indices, values, fields, labels)
+        with TRACER.span("train.compiled_step",
+                         args={"trainer": "ffm_sharded"}):
+            return self._step(state, indices, values, fields, labels)
 
     def make_predict(self):
         """Serve the trained sharded state directly — the SAME
@@ -417,7 +432,8 @@ class FFMShardedTrainer:
         TWO independently padded table families (linear at num_features, V
         at v_dims), so the unpad is field-wise rather than the shared
         spec-driven helper (which assumes one padded extent)."""
-        host = jax.device_get(state)
+        with TRACER.span("train.sync", args={"trainer": "ffm_sharded"}):
+            host = jax.device_get(state)
         nf, dv = self.hyper.num_features, self.hyper.v_dims
         return host.replace(
             w=np.asarray(host.w)[: nf],
@@ -491,11 +507,15 @@ class MCShardedTrainer:
 
     def step(self, state, indices, values, labels):
         """indices/values: [B, K]; labels: [B] int (replicated)."""
-        return self._step(state, indices, values, labels)
+        with TRACER.span("train.compiled_step",
+                         args={"trainer": "mc_sharded"}):
+            return self._step(state, indices, values, labels)
 
     def final_state(self, state):
         """Host-side copy with the padding sliced back off."""
-        return _unpad_state(jax.device_get(state), self.dims,
+        with TRACER.span("train.sync", args={"trainer": "mc_sharded"}):
+            host = jax.device_get(state)
+        return _unpad_state(host, self.dims,
                             self.dims_padded, self._specs, self.axis)
 
     def make_predict(self):
@@ -637,18 +657,23 @@ class Sharded2DTrainer:
         """indices/values: [R, k, B, K]; labels: [R, k, B] — replica r's k
         blocks. Each group of mix_every blocks trains locally, then the
         replicas mix."""
-        return self._step(state, indices, values, labels)
+        with TRACER.span("train.compiled_step",
+                         args={"trainer": "sharded_2d"}):
+            return self._step(state, indices, values, labels)
 
     def shard_blocks(self, indices, values, labels):
         """Host helper: split [R * k, B, ...] blocks into [R, k, B, ...]."""
-        return split_replica_blocks(self.n_replicas, indices, values, labels)
+        with TRACER.span("train.data_prep", args={"trainer": "sharded_2d"}):
+            return split_replica_blocks(self.n_replicas, indices, values,
+                                        labels)
 
     def final_state(self, state: LinearState) -> LinearState:
         """Collapse the replica axis (collapse_linear_replicas: trailing-mix
         weights, touched union, slot merge, Welford merge) and slice the
         padding back off, returning a plain [dims] model."""
-        merged = collapse_linear_replicas(jax.device_get(state),
-                                          dict(self.rule.slot_merge))
+        with TRACER.span("train.sync", args={"trainer": "sharded_2d"}):
+            host = jax.device_get(state)
+        merged = collapse_linear_replicas(host, dict(self.rule.slot_merge))
         # collapsed leaves lost the leading replica axis: strip it from the
         # specs too, then slice the stripe axis they name
         collapsed_specs = jax.tree.map(lambda s: P(*tuple(s)[1:]), self._specs)

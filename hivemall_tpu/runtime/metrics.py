@@ -91,9 +91,13 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # [+Inf] is last
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (value, trace_id, unix_ts): the last sampled
+        # observation that landed there (OpenMetrics exemplar shape) — a
+        # bad p99 bucket links straight to a trace in runtime/tracing.py
+        self._exemplars: Dict[int, tuple] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         v = float(value)
         i = 0
         for i, ub in enumerate(self.buckets):
@@ -101,10 +105,22 @@ class Histogram:
                 break
         else:
             i = len(self.buckets)
+        now = time.time() if trace_id is not None else 0.0
         with self._lock:
             self._counts[i] += 1
             self.sum += v
             self.count += 1
+            if trace_id is not None:
+                self._exemplars[i] = (v, trace_id, now)
+
+    def exemplars(self) -> dict:
+        """{bucket_upper_bound: {"value", "trace_id", "unix"}} for buckets
+        that have one (the +Inf overflow keys as inf)."""
+        with self._lock:
+            items = dict(self._exemplars)
+        bounds = self.buckets + (float("inf"),)
+        return {bounds[i]: {"value": v, "trace_id": tid, "unix": ts}
+                for i, (v, tid, ts) in items.items()}
 
     def snapshot(self) -> dict:
         """{"buckets": [(upper_bound, cumulative_count)...], "sum", "count"}
@@ -120,18 +136,29 @@ class Histogram:
         return {"buckets": out, "sum": s, "count": total}
 
     def quantile(self, q: float) -> float:
-        """Bucket-resolution quantile estimate (upper bound of the bucket
-        holding the q-th observation) — for dashboards/logs; benches that
-        need exact percentiles keep raw samples. Ranks landing in the +Inf
-        overflow clamp to the largest finite bound (the Prometheus
-        histogram_quantile convention — and inf would break strict JSON)."""
+        """Quantile estimate with LINEAR INTERPOLATION inside the holding
+        bucket (the Prometheus histogram_quantile formula): the q-th rank
+        is located in its cumulative bucket, then placed proportionally
+        between the bucket's lower and upper bound — a p50 of values
+        clustered near a bucket's floor no longer over-reports as the
+        bucket's ceiling. For dashboards/logs; benches that need exact
+        percentiles keep raw samples. Ranks landing in the +Inf overflow
+        clamp to the largest finite bound (the histogram_quantile
+        convention — and inf would break strict JSON)."""
         snap = self.snapshot()
         if not snap["count"] or not self.buckets:
             return 0.0
         rank = q * snap["count"]
+        prev_cum, lo = 0, 0.0
         for ub, cum in snap["buckets"]:
-            if cum >= rank and ub != float("inf"):
-                return ub
+            if cum >= rank:
+                if ub == float("inf"):
+                    return self.buckets[-1]
+                in_bucket = cum - prev_cum
+                if in_bucket <= 0:
+                    return ub
+                return lo + (ub - lo) * (rank - prev_cum) / in_bucket
+            prev_cum, lo = cum, ub
         return self.buckets[-1]
 
 
@@ -203,7 +230,8 @@ class MetricsRegistry:
             "counters": counters,
             "gauges": gauges,
             "meters": meters,
-            "histograms": {n: h.snapshot() for n, h in hists},
+            "histograms": {n: {**h.snapshot(), "exemplars": h.exemplars()}
+                           for n, h in hists},
         }
 
 
@@ -277,6 +305,14 @@ class recompile_guard:
         self.registry.counter("graftcheck",
                               f"recompiles.{self.name}").increment(
             self.compiles)
+        if self.compiles:
+            # a cache miss inside an active trace span shows up INSIDE the
+            # request/step that paid for it (late import: tracing is a
+            # leaf module; this path only runs on the cold compile)
+            from .tracing import TRACER
+
+            TRACER.instant("jit_recompile", {"guard": self.name,
+                                             "compiles": self.compiles})
         self.registry.set_gauge(f"{self.name}.jit_cache_entries",
                                 float(sum(sizes)))
         if exc_type is None and self.expect_stable and self.compiles:

@@ -8,7 +8,12 @@ as an HTTP scrape endpoint instead:
 
 - `GET /metrics`  — Prometheus text exposition of the process-wide
   `runtime.metrics.REGISTRY` snapshot (counters, gauges, meters);
-- `GET /healthz`  — liveness (200 + json with process/device info).
+  `?exemplars=1` appends OpenMetrics-style exemplars to histogram bucket
+  lines (`# {trace_id="..."} value ts`) linking buckets to traces;
+- `GET /healthz`  — liveness (200 + json with process/device info);
+- `GET /trace?n=` — the last n committed traces from the process tracer
+  (runtime/tracing.py) as Chrome trace_event JSON: save the body to a
+  file and load it in ui.perfetto.dev (docs/observability.md).
 
 `serve_metrics(port)` starts a daemon thread (stdlib only); every worker
 started by bin/hivemall_tpu_daemon.sh can enable it with
@@ -22,8 +27,10 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from .metrics import REGISTRY
+from .tracing import TRACER
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -39,13 +46,20 @@ def _fmt_le(ub: float) -> str:
     return repr(ub)
 
 
-def render_prometheus(snapshot: Optional[dict] = None) -> str:
+def render_prometheus(snapshot: Optional[dict] = None,
+                      exemplars: bool = False) -> str:
     """Prometheus text exposition with `# HELP` / `# TYPE` metadata.
 
     With no argument, renders the process registry with true metric kinds
     (counter / gauge / histogram; meters surface as gauges). Passing a plain
     `{key: value}` snapshot renders every sample as an untyped gauge — the
     legacy scrape shape, kept for callers that post-process dicts.
+
+    ``exemplars=True`` appends OpenMetrics-style exemplars to histogram
+    bucket lines (``... # {trace_id="..."} value ts``) for buckets that
+    carry one — the link from a bad latency bucket to its sampled trace.
+    Off by default: the 0.0.4 text format predates exemplars and strict
+    scrapers may reject the suffix (OpenMetrics scrapers accept it).
     """
     lines = []
 
@@ -77,8 +91,14 @@ def render_prometheus(snapshot: Optional[dict] = None) -> str:
         h = snap["histograms"][key]
         name = f"hivemall_tpu_{_prom_name(key)}"
         head(name, "histogram", f"fixed-bucket histogram {key}")
+        ex = h.get("exemplars", {}) if exemplars else {}
         for ub, cum in h["buckets"]:
-            lines.append(f'{name}_bucket{{le="{_fmt_le(ub)}"}} {cum}')
+            line = f'{name}_bucket{{le="{_fmt_le(ub)}"}} {cum}'
+            e = ex.get(ub)
+            if e is not None:
+                line += (f' # {{trace_id="{e["trace_id"]}"}} '
+                         f'{e["value"]} {e["unix"]}')
+            lines.append(line)
         lines.append(f"{name}_sum {float(h['sum'])}")
         lines.append(f"{name}_count {h['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
@@ -87,9 +107,20 @@ def render_prometheus(snapshot: Optional[dict] = None) -> str:
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - http.server API
         if self.path.split("?")[0] == "/metrics":
-            body = render_prometheus().encode()
+            qs = parse_qs(urlparse(self.path).query)
+            with_ex = qs.get("exemplars", ["0"])[0] not in ("0", "")
+            body = render_prometheus(exemplars=with_ex).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path.split("?")[0] == "/trace":
+            qs = parse_qs(urlparse(self.path).query)
+            try:
+                n = int(qs.get("n", ["20"])[0])
+            except ValueError:
+                n = 20
+            body = json.dumps(TRACER.chrome_trace(n=n)).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         elif self.path.split("?")[0] == "/healthz":
             info = {"status": "ok"}
             try:

@@ -1,0 +1,467 @@
+# graftcheck: serving-module
+"""End-to-end span tracing: request spans through serving, step timelines
+through training, one Perfetto-loadable export for both.
+
+Aggregate counters and histograms (runtime/metrics.py) say THAT a p99
+regressed or a mesh step stalled; this module says WHERE the time went —
+HTTP parse vs. batcher queue wait vs. bucket pad vs. device dispatch vs.
+host sync. Per-stage timing attribution is a first-class subsystem in the
+production stacks this repo mirrors (PAPERS.md: the ads-infra paper's
+per-stage serving telemetry, the terascale learner's per-phase timing).
+
+Design constraints, in order:
+
+1. **Never block the serving hot path.** Span start/stop is a
+   ``perf_counter_ns`` read plus slot writes; the tracer's single lock
+   guards only the committed-trace ring buffer append and the sampling
+   RNG — no IO, no device sync, no jit dispatch ever runs under it
+   (graftcheck G013 enforces this; the module opts into the serving-module
+   scope with the marker on line 1).
+2. **Spans cross threads by explicit handoff, not ambient magic.** The
+   contextvar tracks the current span per thread; the batcher hop
+   (serving/batcher.py) carries the request's span on the queue entry and
+   the worker parents its spans to it explicitly.
+3. **One trace format.** ``export_chrome()`` emits Chrome ``trace_event``
+   JSON that loads in ui.perfetto.dev / chrome://tracing for serving
+   requests and training steps alike.
+
+Vocabulary:
+
+- a **trace** is one request (or one training step): a root span plus its
+  descendants, identified by ``trace_id``;
+- a **span** is one timed stage (``name``, ``span_id``, ``parent_id``,
+  start/duration, thread, args);
+- an **instant event** is a point-in-time marker inside a span — e.g. a
+  ``jit_recompile`` emitted by ``runtime.metrics.recompile_guard``, so the
+  recompile shows up INSIDE the request that paid for it.
+
+Sampling: the *decision* is made per root span with a seeded RNG
+(deterministic for tests); child spans inherit it. Spans are timed
+regardless (they are cheap); the decision gates which traces are
+*committed* to the ring buffer — plus ``slow_ms``: a root slower than the
+threshold commits even when unsampled, so the tail is never invisible.
+``enabled=False`` turns span creation into a no-op entirely.
+
+Usage::
+
+    from hivemall_tpu.runtime.tracing import TRACER, step_span
+
+    with TRACER.span("engine.pad", args={"rows": n}):
+        staged = servable.stage(chunk, b_pad, width_cap)
+
+    with step_span("sharded_1d", step=i):        # training timeline
+        with TRACER.span("train.data_prep"):
+            blocks = make_blocks(...)
+        state, loss = trainer.step(state, *blocks)   # train.compiled_step
+        sync_ready(loss)                             # train.sync
+
+    TRACER.export_chrome("trace.json")   # -> ui.perfetto.dev
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+_ID_COUNTER = itertools.count(1)  # __next__ is GIL-atomic: no lock needed
+
+
+def _new_id(prefix: str) -> str:
+    return f"{prefix}{next(_ID_COUNTER):x}"
+
+
+class _NullSpan:
+    """Returned when the tracer is disabled — every operation is a no-op,
+    so call sites never branch on tracer state."""
+
+    __slots__ = ()
+    recording = False
+    sampled = False
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+
+    def set(self, **args) -> None:
+        pass
+
+    def event(self, name: str, **args) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Trace:
+    """Per-trace accumulator: the root's sampling decision plus every
+    finished span, committed (or dropped) when the root ends."""
+
+    __slots__ = ("trace_id", "sampled", "spans", "root")
+
+    def __init__(self, trace_id: str, sampled: bool) -> None:
+        self.trace_id = trace_id
+        self.sampled = sampled
+        self.spans: List["Span"] = []  # list.append is GIL-atomic
+        self.root: Optional["Span"] = None
+
+
+class Span:
+    """One timed stage of a trace. Created via Tracer.span()/begin();
+    mutated by exactly one thread at a time (the thread that opened it)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_ns",
+                 "end_ns", "tid", "args", "events", "_trace")
+
+    recording = True
+
+    def __init__(self, name: str, trace: _Trace, parent_id: Optional[str],
+                 start_ns: int) -> None:
+        self.name = name
+        self.trace_id = trace.trace_id
+        self.span_id = _new_id("s")
+        self.parent_id = parent_id
+        self.start_ns = start_ns
+        self.end_ns: Optional[int] = None
+        self.tid = threading.get_ident()
+        self.args: Dict = {}
+        self.events: List = []  # (name, ts_ns, args)
+        self._trace = trace
+
+    @property
+    def sampled(self) -> bool:
+        return self._trace.sampled
+
+    def set(self, **args) -> None:
+        """Attach key/value annotations (shown in the Perfetto args pane)."""
+        self.args.update(args)
+
+    def event(self, name: str, **args) -> None:
+        """Attach an instant event at now (e.g. a jit recompile marker)."""
+        self.events.append((name, time.perf_counter_ns(), args))
+
+    def to_dict(self) -> dict:
+        dur = (self.end_ns - self.start_ns) if self.end_ns is not None else 0
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_us": self.start_ns / 1e3,
+            "dur_us": dur / 1e3,
+            "tid": self.tid,
+            "args": dict(self.args),
+            "events": [{"name": n, "ts_us": ts / 1e3, "args": dict(a)}
+                       for n, ts, a in self.events],
+        }
+
+
+# the thread's (task's) innermost open span; crossed threads only by
+# explicit handoff (Tracer.add_span / span(parent=...))
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "hivemall_tpu_current_span", default=None)
+
+_UNSET = object()
+
+
+class Tracer:
+    """Thread-safe span tracer with a bounded ring of committed traces.
+
+    The hot path (begin/end) takes the lock only to (a) draw one sampling
+    decision per root and (b) append one committed trace per root — both
+    O(1) pointer work. Exports copy the ring under the lock and serialize
+    outside it.
+    """
+
+    def __init__(self, capacity: int = 256, sample_rate: float = 1.0,
+                 slow_ms: Optional[float] = None, seed: Optional[int] = None,
+                 enabled: bool = True, jax_annotations: bool = False) -> None:
+        self.capacity = int(capacity)
+        self.sample_rate = float(sample_rate)
+        self.slow_ms = slow_ms
+        self.enabled = bool(enabled)
+        self.jax_annotations = bool(jax_annotations)
+        self._rng = random.Random(seed)
+        self._ring: deque = deque(maxlen=self.capacity)  # committed traces
+        self._lock = threading.Lock()
+        self.dropped = 0  # unsampled-and-fast roots (observability of loss)
+
+    # -- span lifecycle ------------------------------------------------------
+
+    def current(self) -> Optional[Span]:
+        """The calling thread's innermost open span (None outside any)."""
+        span = _current.get()
+        return span if span is not None and span.recording else None
+
+    def exemplar_id(self, span=None) -> Optional[str]:
+        """trace_id usable as a histogram exemplar (None when the trace
+        cannot land in the ring). Sampled traces always commit; with
+        ``slow_ms`` set, an unsampled trace MAY commit via the slow
+        escape — exactly the tail an exemplar should link to — so its id
+        is returned too (the link can dangle if the root finishes fast;
+        a missing link on the slow tail is the worse failure)."""
+        if span is None:
+            span = self.current()
+        if span is None or not span.recording:
+            return None
+        if span.sampled or self.slow_ms is not None:
+            return span.trace_id
+        return None
+
+    def _sample(self) -> bool:
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        with self._lock:
+            return self._rng.random() < self.sample_rate
+
+    def begin(self, name: str, parent=_UNSET,
+              start_ns: Optional[int] = None, args: Optional[dict] = None):
+        """Open a span (manual pairing with end(); prefer span()). parent
+        defaults to the calling thread's current span; pass an explicit
+        Span for cross-thread parenting or None to force a new root."""
+        if not self.enabled:
+            return NULL_SPAN
+        if parent is _UNSET:
+            parent = self.current()
+        if parent is not None and parent.recording:
+            trace = parent._trace
+            parent_id = parent.span_id
+        else:
+            trace = _Trace(_new_id("t"), self._sample())
+            parent_id = None
+        span = Span(name, trace,
+                    parent_id, start_ns if start_ns is not None
+                    else time.perf_counter_ns())
+        if parent_id is None:
+            trace.root = span
+        if args:
+            span.args.update(args)
+        return span
+
+    def end(self, span, end_ns: Optional[int] = None) -> None:
+        """Close a span; when it is its trace's root, commit (sampled or
+        slower than slow_ms) or drop the whole trace."""
+        if not span.recording:
+            return
+        span.end_ns = end_ns if end_ns is not None else time.perf_counter_ns()
+        trace = span._trace
+        trace.spans.append(span)
+        if span is not trace.root:
+            return
+        dur_ms = (span.end_ns - span.start_ns) / 1e6
+        if trace.sampled or (self.slow_ms is not None
+                             and dur_ms >= self.slow_ms):
+            committed = {
+                "trace_id": trace.trace_id,
+                "root": span.name,
+                "duration_ms": dur_ms,
+                "sampled": trace.sampled,
+                "spans": [s.to_dict() for s in trace.spans],
+            }
+            with self._lock:
+                self._ring.append(committed)
+        else:
+            with self._lock:  # read-modify-write: racy without the lock
+                self.dropped += 1
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent=_UNSET,
+             args: Optional[dict] = None) -> Iterator[Span]:
+        """Context-managed span, set as the thread's current for its
+        extent so nested spans parent automatically. With
+        ``jax_annotations=True`` the extent is also wrapped in a
+        jax.profiler.TraceAnnotation, so the stage shows up in xprof
+        device timelines under the same name."""
+        span = self.begin(name, parent=parent, args=args)
+        if span is NULL_SPAN:
+            yield span
+            return
+        token = _current.set(span)
+        try:
+            if self.jax_annotations:
+                import jax
+
+                with jax.profiler.TraceAnnotation(name):
+                    yield span
+            else:
+                yield span
+        finally:
+            _current.reset(token)
+            self.end(span)
+
+    def add_span(self, name: str, parent, start_ns: int, end_ns: int,
+                 args: Optional[dict] = None) -> None:
+        """Record an already-elapsed interval as a child span — the
+        queue-wait idiom: the batcher worker stamps [enqueued, taken] as a
+        span parented to the span the request was submitted under."""
+        if not self.enabled or parent is None or not parent.recording:
+            return
+        span = Span(name, parent._trace, parent.span_id, start_ns)
+        if args:
+            span.args.update(args)
+        span.end_ns = end_ns
+        parent._trace.spans.append(span)
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        """Attach an instant event to the calling thread's current span
+        (no-op outside any span) — recompile markers, cache misses."""
+        span = self.current()
+        if span is not None:
+            span.event(name, **(args or {}))
+
+    # -- inspection / export -------------------------------------------------
+
+    def traces(self, n: Optional[int] = None) -> List[dict]:
+        """The last ``n`` committed traces, oldest first (n=None: all;
+        n <= 0: none — NOT all: out[-0:] would be the whole list)."""
+        with self._lock:
+            out = list(self._ring)
+        if n is not None:
+            n = int(n)
+            out = out[-n:] if n > 0 else []
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def slowest(self, k: int = 5, n: Optional[int] = None) -> List[dict]:
+        """Top-k slowest committed traces with their per-stage totals —
+        the "where did the p99 go" artifact bench_serving.py dumps."""
+        ranked = sorted(self.traces(n), key=lambda t: -t["duration_ms"])[:k]
+        out = []
+        for t in ranked:
+            stages: Dict[str, float] = {}
+            for s in t["spans"]:
+                stages[s["name"]] = stages.get(s["name"], 0.0) \
+                    + s["dur_us"] / 1e3
+            out.append({"trace_id": t["trace_id"], "root": t["root"],
+                        "duration_ms": round(t["duration_ms"], 3),
+                        "stages_ms": {k_: round(v, 3)
+                                      for k_, v in sorted(stages.items())}})
+        return out
+
+    def stage_breakdown(self, n: Optional[int] = None) -> Dict[str, dict]:
+        """Aggregate per-stage time across committed traces:
+        {stage: {count, total_ms, mean_ms, max_ms}}."""
+        agg: Dict[str, List[float]] = {}
+        for t in self.traces(n):
+            for s in t["spans"]:
+                agg.setdefault(s["name"], []).append(s["dur_us"] / 1e3)
+        return {
+            name: {
+                "count": len(ds),
+                "total_ms": round(sum(ds), 3),
+                "mean_ms": round(sum(ds) / len(ds), 4),
+                "max_ms": round(max(ds), 3),
+            }
+            for name, ds in sorted(agg.items())
+        }
+
+    def chrome_trace(self, n: Optional[int] = None) -> dict:
+        """Chrome/Perfetto ``trace_event`` JSON (the dict; export_chrome
+        writes it). Spans map to complete ("X") events, instant events to
+        "i" events, all stamped with trace/span ids in args so Perfetto
+        queries can join them back to exemplars."""
+        pid = os.getpid()
+        events = []
+        committed = self.traces(n)  # ONE ring copy: count == events' source
+        for t in committed:
+            for s in t["spans"]:
+                events.append({
+                    "name": s["name"],
+                    "cat": "hivemall_tpu",
+                    "ph": "X",
+                    "ts": s["start_us"],
+                    "dur": s["dur_us"],
+                    "pid": pid,
+                    "tid": s["tid"],
+                    "args": {**s["args"], "trace_id": s["trace_id"],
+                             "span_id": s["span_id"],
+                             "parent_id": s["parent_id"]},
+                })
+                for ev in s["events"]:
+                    events.append({
+                        "name": ev["name"],
+                        "cat": "hivemall_tpu",
+                        "ph": "i",
+                        "s": "t",
+                        "ts": ev["ts_us"],
+                        "pid": pid,
+                        "tid": s["tid"],
+                        "args": {**ev["args"], "trace_id": s["trace_id"]},
+                    })
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"source": "hivemall_tpu.runtime.tracing",
+                              "traces": len(committed)}}
+
+    def export_chrome(self, path: str, n: Optional[int] = None) -> dict:
+        """Write the Chrome trace to ``path`` (load it in ui.perfetto.dev
+        or chrome://tracing); returns the exported dict. Serialization
+        happens OUTSIDE the tracer lock (chrome_trace copies first)."""
+        doc = self.chrome_trace(n)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return doc
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# Process-wide tracer, knobs via environment:
+#   HIVEMALL_TPU_TRACE=0             disable entirely
+#   HIVEMALL_TPU_TRACE_SAMPLE=0.1    sample 10% of roots
+#   HIVEMALL_TPU_TRACE_SLOW_MS=50    always commit roots >= 50 ms
+#   HIVEMALL_TPU_TRACE_CAPACITY=256  ring size (committed traces)
+#   HIVEMALL_TPU_TRACE_JAX=1         bridge spans into jax TraceAnnotations
+_slow = os.environ.get("HIVEMALL_TPU_TRACE_SLOW_MS")
+TRACER = Tracer(
+    capacity=int(_env_float("HIVEMALL_TPU_TRACE_CAPACITY", 256)),
+    sample_rate=_env_float("HIVEMALL_TPU_TRACE_SAMPLE", 1.0),
+    slow_ms=float(_slow) if _slow else None,
+    enabled=os.environ.get("HIVEMALL_TPU_TRACE", "1") != "0",
+    jax_annotations=os.environ.get("HIVEMALL_TPU_TRACE_JAX", "0") == "1",
+)
+
+
+@contextlib.contextmanager
+def step_span(trainer: str, step: Optional[int] = None,
+              tracer: Optional[Tracer] = None) -> Iterator[Span]:
+    """Root span for ONE training step — the per-step timeline the sharded
+    and mix trainers feed: open it in the driving loop, and the trainer's
+    dispatch lands as a ``train.compiled_step`` child, host block building
+    under ``train.data_prep``, ``sync_ready`` as ``train.sync``::
+
+        for i, blk in enumerate(blocks):
+            with step_span("sharded_1d", step=i):
+                state, loss = trainer.step(state, *blk)
+                sync_ready(loss)
+    """
+    t = tracer if tracer is not None else TRACER
+    args = {"trainer": trainer}
+    if step is not None:
+        args["step"] = int(step)
+    with t.span("train.step", args=args) as s:
+        yield s
+
+
+def sync_ready(tree, tracer: Optional[Tracer] = None):
+    """jax.block_until_ready under a ``train.sync`` span — makes the
+    host-sync cost of a step visible as its own stage; returns ``tree``."""
+    t = tracer if tracer is not None else TRACER
+    with t.span("train.sync"):
+        import jax
+
+        return jax.block_until_ready(tree)

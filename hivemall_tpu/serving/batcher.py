@@ -17,6 +17,16 @@ carries (PAPERS.md ads-infra paper). Policy:
 
 Metrics (runtime.metrics.REGISTRY): queue-depth gauge, batch-occupancy and
 queue-delay histograms, accepted/rejected counters.
+
+Tracing (runtime.tracing.TRACER): the request's span is captured at
+submit() and carried ON the queue entry across the thread hop — the worker
+parents its spans to it explicitly (contextvars do not cross threads). The
+enqueue->dispatch wait is recorded retroactively as a ``queue.wait`` child
+span; the merged device call runs under a ``batch.predict`` span parented
+to the first traced request of the batch, and every other request in the
+batch gets a ``batched`` instant event linking to that trace. A submit with
+no ambient span (direct batcher users) opens its own ``serving.request``
+root, ended by the future's done-callback.
 """
 
 from __future__ import annotations
@@ -28,6 +38,7 @@ from concurrent.futures import Future
 from typing import Callable, List, Sequence
 
 from ..runtime.metrics import REGISTRY
+from ..runtime.tracing import TRACER
 
 OCCUPANCY_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 DELAY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
@@ -43,12 +54,16 @@ class BatcherClosed(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("instances", "future", "enqueued")
+    # span/owns_span publish immutably in __init__ BEFORE the entry is
+    # visible to the worker thread (set post-append would race the take)
+    __slots__ = ("instances", "future", "enqueued", "span", "owns_span")
 
-    def __init__(self, instances) -> None:
+    def __init__(self, instances, span, owns_span: bool) -> None:
         self.instances = instances
         self.future: Future = Future()
         self.enqueued = time.perf_counter()
+        self.span = span  # the request's trace span (maybe NULL_SPAN)
+        self.owns_span = owns_span  # True: we opened it, done-cb ends it
 
 
 class DynamicBatcher:
@@ -86,7 +101,19 @@ class DynamicBatcher:
             f: Future = Future()
             f.set_result([])
             return f
-        p = _Pending(list(instances))
+        # capture the caller's span for the thread hop; with no ambient
+        # span open our own request root (ended by the done-callback). A
+        # rejected submit abandons an owned span un-ended — it is never
+        # committed, which is the point: 503s don't fill the ring.
+        cur = TRACER.current()
+        if cur is not None:
+            span, owns = cur, False
+        else:
+            span = TRACER.begin("serving.request", parent=None,
+                                args={"batcher": self.name,
+                                      "rows": len(instances)})
+            owns = span.recording
+        p = _Pending(list(instances), span, owns)
         with self._cv:
             if self._closed:
                 raise BatcherClosed(f"batcher {self.name!r} is closed")
@@ -101,6 +128,8 @@ class DynamicBatcher:
                                float(self._depth_rows))
             self._cv.notify()
         self._accepted.increment()
+        if owns:
+            p.future.add_done_callback(lambda f, s=span: TRACER.end(s))
         return p.future
 
     def close(self, drain: bool = True) -> None:
@@ -162,13 +191,37 @@ class DynamicBatcher:
             if not batch:
                 return
             now = time.perf_counter()
+            now_ns = time.perf_counter_ns()
             rows: List = []
             for p in batch:
-                self._delay.observe(now - p.enqueued)
+                self._delay.observe(now - p.enqueued,
+                                    trace_id=TRACER.exemplar_id(p.span))
+                # the enqueue->take wait, recorded retroactively into the
+                # request's trace (the hop: submit thread -> this thread)
+                TRACER.add_span("queue.wait", p.span,
+                                int(p.enqueued * 1e9), now_ns,
+                                args={"batcher": self.name,
+                                      "rows": len(p.instances)})
                 rows.extend(p.instances)
             self._occupancy.observe(len(rows))
+            # the merged device call belongs to ONE trace: the first
+            # SAMPLED request of the batch (an unsampled first request
+            # would take the device-side spans into a trace that gets
+            # dropped, leaving every committed trace stage-less); only
+            # when nothing is sampled fall back to the first recording
+            # span, whose trace can still commit via the slow_ms escape
+            rep = next((p.span for p in batch
+                        if p.span.recording and p.span.sampled), None) \
+                or next((p.span for p in batch if p.span.recording), None)
+            for p in batch:
+                if p.span.recording and p.span is not rep:
+                    p.span.event("batched", in_trace=rep.trace_id,
+                                 batch_rows=len(rows))
             try:
-                preds = self.predict_fn(rows)
+                with TRACER.span("batch.predict", parent=rep,
+                                 args={"rows": len(rows),
+                                       "requests": len(batch)}):
+                    preds = self.predict_fn(rows)
             except Exception as e:  # fail the batch, not the process
                 for p in batch:
                     if not p.future.cancelled():

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..core.batch import pack_rows, pad_to_bucket
 from ..runtime.metrics import REGISTRY, recompile_guard
+from ..runtime.tracing import TRACER
 from .artifact import Artifact, family_of, load, rebuild_model
 
 # serving latency is sub-ms-to-seconds shaped; finer low end than the
@@ -58,9 +59,19 @@ def _bf16_or(name: str):
 class _Servable:
     """Family adapter: host staging + padded jitted scoring.
 
-    ``run_padded(instances, b_pad, width_cap)`` stages, pads to
-    ``[b_pad, width_bucket]`` and scores; ``finalize(raw, n)`` maps the
-    padded raw output back to ``n`` user-facing predictions.
+    The request path is three explicitly separated stages so the tracer
+    (runtime/tracing.py) can attribute time per stage:
+
+    - ``stage(instances, b_pad, width_cap)`` — host-side parse + pad to
+      ``[b_pad, width_bucket]`` arrays (the "pad" span);
+    - ``dispatch(staged)`` — the device scoring call on staged arrays,
+      asynchronous for the jitted families (the "dispatch" span);
+    - ``finalize(raw, n)`` — map padded raw output back to ``n``
+      user-facing predictions; materializing the device result here is
+      where the host blocks (the "block" span).
+
+    ``run_padded`` composes stage+dispatch for callers that don't need
+    the split (warmup).
     """
 
     family: str = ""
@@ -69,8 +80,14 @@ class _Servable:
     # only have the batch axis
     has_width: bool = True
 
-    def run_padded(self, instances, b_pad: int, width_cap: int):
+    def stage(self, instances, b_pad: int, width_cap: int):
         raise NotImplementedError
+
+    def dispatch(self, staged):
+        raise NotImplementedError
+
+    def run_padded(self, instances, b_pad: int, width_cap: int):
+        return self.dispatch(self.stage(instances, b_pad, width_cap))
 
     def finalize(self, raw, n: int):
         return np.asarray(raw)[:n]
@@ -95,7 +112,7 @@ class _SparseRowServable(_Servable):
     def __init__(self, dims: int) -> None:
         self.dims = dims
 
-    def _pack(self, instances, b_pad: int, width_cap: int):
+    def stage(self, instances, b_pad: int, width_cap: int):
         from ..models.base import _stage_rows
 
         idx_rows, val_rows = _stage_rows(instances, self.dims)
@@ -119,9 +136,8 @@ class _LinearServable(_SparseRowServable):
         self._predict = make_predict(use_covariance=False)
         self.jit_fns = (self._predict,)
 
-    def run_padded(self, instances, b_pad, width_cap):
-        blk = self._pack(instances, b_pad, width_cap)
-        return self._predict(self.state, blk.indices, blk.values)
+    def dispatch(self, staged):
+        return self._predict(self.state, staged.indices, staged.values)
 
 
 class _MulticlassServable(_SparseRowServable):
@@ -136,9 +152,9 @@ class _MulticlassServable(_SparseRowServable):
         self._scores = _mc_scores
         self.jit_fns = (_mc_scores,)
 
-    def run_padded(self, instances, b_pad, width_cap):
-        blk = self._pack(instances, b_pad, width_cap)
-        return self._scores(self.state.weights, blk.indices, blk.values)
+    def dispatch(self, staged):
+        return self._scores(self.state.weights, staged.indices,
+                            staged.values)
 
     def finalize(self, raw, n):
         scores = np.asarray(raw)[:n]
@@ -156,9 +172,8 @@ class _FMServable(_SparseRowServable):
         self._scores = _fm_scores
         self.jit_fns = (_fm_scores,)
 
-    def run_padded(self, instances, b_pad, width_cap):
-        blk = self._pack(instances, b_pad, width_cap)
-        return self._scores(self.state, blk.indices, blk.values)
+    def dispatch(self, staged):
+        return self._scores(self.state, staged.indices, staged.values)
 
 
 class _FFMServable(_Servable):
@@ -172,7 +187,7 @@ class _FFMServable(_Servable):
         self._scores = _ffm_scores_jit
         self.jit_fns = (_ffm_scores_jit,)
 
-    def run_padded(self, instances, b_pad, width_cap):
+    def stage(self, instances, b_pad, width_cap):
         from ..utils.feature import FMFeature
 
         hy = self.hyper
@@ -188,7 +203,11 @@ class _FFMServable(_Servable):
                 idx[r, c] = f.index % hy.num_features
                 val[r, c] = f.value
                 fld[r, c] = (f.field if f.field >= 0 else 0) % hy.num_fields
-        return self._scores(hy, self.state, idx, val, fld)
+        return idx, val, fld
+
+    def dispatch(self, staged):
+        idx, val, fld = staged
+        return self._scores(self.hyper, self.state, idx, val, fld)
 
     def dummy_instance(self, width):
         return [f"{k % 8}:{k}:1.0" for k in range(width)]
@@ -205,12 +224,16 @@ class _MFServable(_Servable):
     def __init__(self, model) -> None:
         self.model = model
 
-    def run_padded(self, instances, b_pad, width_cap):
+    def stage(self, instances, b_pad, width_cap):
         pairs = np.asarray(instances, np.int64).reshape(len(instances), 2)
         u = np.zeros(b_pad, np.int64)
         i = np.zeros(b_pad, np.int64)
         u[:len(instances)] = pairs[:, 0]
         i[:len(instances)] = pairs[:, 1]
+        return u, i
+
+    def dispatch(self, staged):
+        u, i = staged
         return self.model.predict(u, i)
 
     def dummy_instance(self, width):
@@ -231,7 +254,7 @@ class _TreeServable(_Servable):
         self._walk = predict_forest_binned
         self.jit_fns = (predict_forest_binned,)
 
-    def _binned_padded(self, instances, b_pad):
+    def stage(self, instances, b_pad, width_cap):
         from ..models.trees.binning import bin_data
 
         X = np.asarray(instances, np.float64).reshape(len(instances),
@@ -240,11 +263,10 @@ class _TreeServable(_Servable):
         Xb[:len(instances)] = bin_data(X, self.bins)
         return Xb
 
-    def run_padded(self, instances, b_pad, width_cap):
-        Xb = self._binned_padded(instances, b_pad)
+    def dispatch(self, staged):
         if self.stacked is None:
-            return np.zeros((0, b_pad))
-        return self._walk(self.stacked, Xb)
+            return np.zeros((0, staged.shape[0]))
+        return self._walk(self.stacked, staged)
 
     def dummy_instance(self, width):
         return [0.0] * self.n_features
@@ -446,8 +468,12 @@ class ServingEngine:
         cache misses the sweep cost (all of them paid here, none in steady
         state). Idempotent — a second warmup compiles nothing."""
         t0 = time.perf_counter()
-        with recompile_guard(f"serving.{self.name}.warmup",
-                             *self.servable.jit_fns) as g:
+        # the warmup span makes every deploy-time compile visible as a
+        # jit_recompile instant INSIDE a trace (recompile_guard emits them)
+        with TRACER.span("engine.warmup", args={"engine": self.name,
+                                                "family": self.family}), \
+                recompile_guard(f"serving.{self.name}.warmup",
+                                *self.servable.jit_fns) as g:
             for width in self.width_buckets():
                 inst = self.servable.dummy_instance(width or 8)
                 for b in self.batch_buckets():
@@ -461,26 +487,45 @@ class ServingEngine:
         return g.compiles
 
     def predict(self, instances: Sequence):
-        """Score a request of any size (chunks above max_batch)."""
+        """Score a request of any size (chunks above max_batch). Each
+        chunk's path is traced stage by stage — bucket selection, host
+        pad, device dispatch, host block — as child spans of whatever
+        request span is active (runtime/tracing.py), so a slow predict is
+        attributable from the trace alone."""
         n = len(instances)
         if n == 0:
             return []
         t0 = time.perf_counter()
         outs = []
-        for s in range(0, n, self.max_batch):
-            chunk = instances[s:s + self.max_batch]
-            if self.servable.has_width:
-                overwide = self.servable.count_overwide(chunk, self.max_width)
-                if overwide:
-                    self._truncated.increment(overwide)
-            b_pad = self.bucket_batch(len(chunk))
-            with recompile_guard(f"serving.{self.name}",
-                                 *self.servable.jit_fns):
-                raw = self.servable.run_padded(chunk, b_pad, self.max_width)
-                out = self.servable.finalize(raw, len(chunk))
-            outs.append(out)
-        self._rows.increment(n)
-        self._latency.observe(time.perf_counter() - t0)
+        with TRACER.span("engine.predict",
+                         args={"engine": self.name, "family": self.family,
+                               "rows": n}) as pspan:
+            for s in range(0, n, self.max_batch):
+                chunk = instances[s:s + self.max_batch]
+                with TRACER.span("engine.bucket") as bspan:
+                    if self.servable.has_width:
+                        overwide = self.servable.count_overwide(
+                            chunk, self.max_width)
+                        if overwide:
+                            self._truncated.increment(overwide)
+                    b_pad = self.bucket_batch(len(chunk))
+                    bspan.set(rows=len(chunk), b_pad=b_pad)
+                with TRACER.span("engine.pad", args={"b_pad": b_pad}):
+                    staged = self.servable.stage(chunk, b_pad,
+                                                 self.max_width)
+                with recompile_guard(f"serving.{self.name}",
+                                     *self.servable.jit_fns):
+                    with TRACER.span("engine.dispatch"):
+                        raw = self.servable.dispatch(staged)
+                    # finalize materializes the device result on the host
+                    # — this is where an async dispatch is actually waited
+                    # on (block_until_ready by another name)
+                    with TRACER.span("engine.block"):
+                        out = self.servable.finalize(raw, len(chunk))
+                outs.append(out)
+            self._rows.increment(n)
+            self._latency.observe(time.perf_counter() - t0,
+                                  trace_id=TRACER.exemplar_id(pspan))
         if len(outs) == 1:
             return outs[0]
         if isinstance(outs[0], np.ndarray):

@@ -15,8 +15,10 @@ HTTP surface (layered on runtime/metrics_http.py — same process, one port):
   ``{"model", "version", "predictions": [...]}``; 503 + Retry-After under
   backpressure (batcher QueueFull), 404 unknown model, 400 bad payload;
 - ``GET /models``    registry listing (name, version, family, counters);
-- ``GET /metrics`` / ``GET /healthz`` — inherited from metrics_http, now
-  carrying the serving latency/occupancy/queue histograms.
+- ``GET /metrics`` / ``GET /healthz`` / ``GET /trace?n=`` — inherited from
+  metrics_http: the serving latency/occupancy/queue histograms (with
+  trace exemplars under ``?exemplars=1``) and the last n request traces
+  as Chrome/Perfetto JSON (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -31,6 +33,7 @@ import numpy as np
 
 from ..runtime import metrics_http
 from ..runtime.metrics import REGISTRY
+from ..runtime.tracing import TRACER
 from .batcher import BatcherClosed, DynamicBatcher, QueueFull
 from .engine import ServingEngine
 
@@ -205,39 +208,55 @@ class _ServingHandler(metrics_http._Handler):
         if self.path.split("?")[0] != "/predict":
             self._send_json(404, {"error": "not found"})
             return
-        try:
-            length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
-            instances = payload["instances"]
-            if not isinstance(instances, list):
-                raise TypeError("instances must be a list")
-        except (KeyError, TypeError, ValueError) as e:
-            self._send_json(400, {"error": f"bad request: {e}"})
-            return
-        t0 = time.perf_counter()
-        try:
-            # registry.submit retries across a hot swap, so a v1->v2 deploy
-            # never fails a request; only an unknown name / undeploy 404s
-            entry, future = self.server.registry.submit(
-                payload.get("model"), instances)
-            if entry is None:
-                self._send_json(404, {"error": f"unknown model "
-                                               f"{payload.get('model')!r}"})
+        # the request's ROOT span: HTTP parse, queue wait, batched device
+        # dispatch and the response write all land under it; the latency
+        # histogram observation carries its trace_id as an exemplar
+        with TRACER.span("server.predict") as root:
+            with TRACER.span("server.parse"):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    instances = payload["instances"]
+                    if not isinstance(instances, list):
+                        raise TypeError("instances must be a list")
+                except (KeyError, TypeError, ValueError) as e:
+                    self._send_json(400, {"error": f"bad request: {e}"})
+                    root.set(status=400)
+                    return
+            root.set(instances=len(instances),
+                     model=payload.get("model") or "")
+            t0 = time.perf_counter()
+            try:
+                # registry.submit retries across a hot swap, so a v1->v2
+                # deploy never fails a request; only an unknown name /
+                # undeploy 404s
+                entry, future = self.server.registry.submit(
+                    payload.get("model"), instances)
+                if entry is None:
+                    self._send_json(404,
+                                    {"error": f"unknown model "
+                                              f"{payload.get('model')!r}"})
+                    root.set(status=404)
+                    return
+                preds = future.result(timeout=self.predict_timeout)
+            except (QueueFull, BatcherClosed) as e:
+                self._send_json(503, {"error": str(e)},
+                                extra_headers=(("Retry-After", "1"),))
+                root.set(status=503)
                 return
-            preds = future.result(timeout=self.predict_timeout)
-        except (QueueFull, BatcherClosed) as e:
-            self._send_json(503, {"error": str(e)},
-                            extra_headers=(("Retry-After", "1"),))
-            return
-        except Exception as e:  # scoring bug — surface, don't hang
-            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
-            return
-        self.server.latency.observe(time.perf_counter() - t0)
-        self._send_json(200, {
-            "model": entry.name,
-            "version": entry.version,
-            "predictions": [_jsonable(p) for p in preds],
-        })
+            except Exception as e:  # scoring bug — surface, don't hang
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+                root.set(status=500)
+                return
+            self.server.latency.observe(
+                time.perf_counter() - t0,
+                trace_id=TRACER.exemplar_id(root))
+            root.set(status=200, version=entry.version)
+            self._send_json(200, {
+                "model": entry.name,
+                "version": entry.version,
+                "predictions": [_jsonable(p) for p in preds],
+            })
 
 
 def _jsonable(p):

@@ -25,6 +25,14 @@ Verifies the two serving invariants while measuring:
 Output: one BENCH-style JSON line (the bench.py shape). `--smoke` runs a
 seconds-scale version and exits non-zero if an invariant breaks — wired
 into scripts/test.sh as the serving smoke gate.
+
+Tracing (runtime/tracing.py): under `--http` the run also writes the
+request traces as Chrome/Perfetto JSON (`--trace-out`, default
+serving_trace.json — load in ui.perfetto.dev) and embeds a per-stage
+(queue/pad/dispatch/block) time breakdown plus the top-5 slowest traces in
+the BENCH JSON, so a latency regression is attributable from the artifact
+alone; the smoke gate additionally fails unless the traces cover >= 4 of
+the request-path stage names (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -42,8 +50,30 @@ import numpy as np
 sys.path.insert(0, ".")  # noqa: E402 — runnable as scripts/bench_serving.py
 
 from hivemall_tpu.runtime.metrics import REGISTRY  # noqa: E402
+from hivemall_tpu.runtime.tracing import TRACER  # noqa: E402
 from hivemall_tpu.serving import (DynamicBatcher, ServingEngine,  # noqa: E402
                                   load)
+
+# the stage vocabulary a request trace must cover for the bench artifact to
+# be attribution-grade (server root, queue wait, pad, device dispatch/block)
+REQUIRED_STAGES = {"server.predict", "queue.wait", "engine.pad",
+                   "engine.dispatch", "engine.block"}
+
+
+def trace_report(trace_path):
+    """Export the tracer ring to `trace_path` (Chrome/Perfetto JSON) and
+    return the BENCH-JSON tracing block: per-stage time breakdown + the
+    top-5 slowest traces — a p99 regression is attributable from the
+    artifact alone, no re-run needed."""
+    doc = TRACER.export_chrome(trace_path)
+    stage_names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    return {
+        "trace_file": trace_path,
+        "traces_committed": doc["otherData"]["traces"],
+        "distinct_stages": sorted(stage_names),
+        "stage_breakdown_ms": TRACER.stage_breakdown(),
+        "slowest_traces": TRACER.slowest(5),
+    }, stage_names
 
 
 def _train_default(dims: int, n_rows: int, seed: int = 7):
@@ -286,10 +316,12 @@ def run_http_mode(args, source, rows, tag) -> int:
     pool = _request_pool(rows, args.requests, args.instances_per_request)
     guard = REGISTRY.counter("graftcheck", "recompiles.serving.bench")
 
+    TRACER.clear()  # measure request traces only, not deploy/warmup ones
     recompiles0 = guard.value
     lat, wall, errors = http_closed_loop(port, pool, args.concurrency)
     steady_recompiles = guard.value - recompiles0
     p = _percentiles(lat) if lat else {50: 0, 95: 0, 99: 0}
+    tracing_block, stage_names = trace_report(args.trace_out)
 
     def factory(v):
         return _train_default(args.dims, args.train_rows, seed=v)[0]
@@ -311,6 +343,7 @@ def run_http_mode(args, source, rows, tag) -> int:
                      "failed_requests": len(swap_failures),
                      "versions_observed": sorted(versions)},
         "request_errors": len(errors),
+        "tracing": tracing_block,
         "extra_metrics": [
             {"metric": "http_p50_ms", "value": round(p[50], 3)},
             {"metric": "http_p95_ms", "value": round(p[95], 3)},
@@ -319,12 +352,17 @@ def run_http_mode(args, source, rows, tag) -> int:
     }
     print(json.dumps(result))
 
+    # a request trace missing most of the stage vocabulary means the span
+    # wiring broke somewhere between server.py and engine.py — gate on it
+    traced_ok = len(stage_names & REQUIRED_STAGES) >= 4
     ok = (steady_recompiles == 0 and not swap_failures and not errors
-          and {"1", "2"} <= versions)
+          and {"1", "2"} <= versions and traced_ok)
     if args.smoke and not ok:
         print(f"SMOKE FAIL: steady_state_recompiles={steady_recompiles} "
               f"swap_failures={swap_failures[:3]} errors={errors[:3]} "
-              f"versions={sorted(versions)}", file=sys.stderr)
+              f"versions={sorted(versions)} "
+              f"traced_stages={sorted(stage_names & REQUIRED_STAGES)}",
+              file=sys.stderr)
         return 1
     return 0
 
@@ -359,6 +397,10 @@ def main() -> int:
                     help="drive POST /predict end-to-end (registry + HTTP "
                          "endpoint in-process) instead of calling the "
                          "engine directly")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the request traces as Chrome/Perfetto JSON "
+                         "here (default serving_trace.json under --http; "
+                         "off in in-process mode unless set)")
     args = ap.parse_args()
     # resolve the sentinel defaults: full-size normally, seconds-scale
     # under --smoke; an explicitly-passed flag always wins, even when its
@@ -385,6 +427,8 @@ def main() -> int:
             raise SystemExit("--http benching needs a request generator "
                              "for the artifact family; only the default "
                              "AROW flow ships one")
+        if args.trace_out is None:
+            args.trace_out = "serving_trace.json"
         return run_http_mode(args, source, rows, tag)
 
     engine_kw = {"max_batch": args.max_batch, "max_width": args.max_width}
@@ -402,6 +446,7 @@ def main() -> int:
     guard = REGISTRY.counter("graftcheck", "recompiles.serving.bench")
 
     # -- closed loop ---------------------------------------------------------
+    TRACER.clear()  # request traces only, not the warmup sweep's
     batcher = DynamicBatcher(engine.predict, name="bench", **batcher_kw)
     recompiles0 = guard.value
     closed_lat, closed_wall, closed_err = closed_loop(
@@ -423,6 +468,10 @@ def main() -> int:
     swap_served, swap_failures = hot_swap_probe(
         factory, batcher_kw, engine_kw, pool, args.concurrency)
 
+    tracing_block = None
+    if args.trace_out:
+        tracing_block, _ = trace_report(args.trace_out)
+
     occupancy = REGISTRY.histogram("serving.bench.batch_occupancy")
     result = {
         "metric": f"serving_closed_loop_throughput_{tag}",
@@ -436,6 +485,7 @@ def main() -> int:
         "hot_swap": {"requests_served": swap_served,
                      "failed_requests": len(swap_failures)},
         "request_errors": len(closed_err) + len(open_err),
+        **({"tracing": tracing_block} if tracing_block else {}),
         "extra_metrics": [
             {"metric": "closed_loop_p50_ms", "value": round(closed_p[50], 3)},
             {"metric": "closed_loop_p95_ms", "value": round(closed_p[95], 3)},

@@ -8,7 +8,15 @@ call is timed under `recompile_guard` (runtime/metrics.py), which counts jit
 cache misses, and the steady loop runs under `expect_stable=True` so a
 kernel that silently retraces per call (a G001 recompile hazard) fails the
 benchmark loudly instead of publishing a compile-dominated number.
+
+`--trace-out PATH` additionally emits the same breakdown as a
+Chrome/Perfetto trace via runtime/tracing.py — one `profile.<kernel>` root
+per kernel with `compile` / `steady` child spans (the compile span carries
+the jit_recompile instant events recompile_guard fires), loadable in
+ui.perfetto.dev next to serving traces: training and serving share one
+trace format (docs/observability.md).
 """
+import argparse
 import os
 import sys
 import time
@@ -20,22 +28,27 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from hivemall_tpu.runtime.metrics import recompile_guard
+from hivemall_tpu.runtime.tracing import TRACER
 
 
 def timeit(name, fn, *args, n=20):
     """-> (compile_ms, steady_ms, n_compiles). First call timed apart from
-    the steady loop; cache misses counted per phase."""
-    with recompile_guard(f"profile.{name}.warmup", fn) as warm:
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        compile_ms = (time.perf_counter() - t0) * 1e3
-    with recompile_guard(f"profile.{name}", fn, expect_stable=True):
-        t0 = time.perf_counter()
-        for _ in range(n):
+    the steady loop; cache misses counted per phase. Each phase is also a
+    trace span under a `profile.<name>` root."""
+    with TRACER.span(f"profile.{name}"):
+        with TRACER.span("compile"), \
+                recompile_guard(f"profile.{name}.warmup", fn) as warm:
+            t0 = time.perf_counter()
             out = fn(*args)
-        jax.block_until_ready(out)
-        steady_ms = (time.perf_counter() - t0) / n * 1e3
+            jax.block_until_ready(out)
+            compile_ms = (time.perf_counter() - t0) * 1e3
+        with TRACER.span("steady", args={"iters": n}), \
+                recompile_guard(f"profile.{name}", fn, expect_stable=True):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            steady_ms = (time.perf_counter() - t0) / n * 1e3
     return compile_ms, steady_ms, warm.compiles
 
 
@@ -46,6 +59,13 @@ def report(name, fn, *args, n=20):
 
 
 def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace-out", default=None,
+                    help="write the compile-vs-steady breakdown as "
+                         "Chrome/Perfetto trace JSON (ui.perfetto.dev)")
+    args = ap.parse_args()
+    if args.trace_out:
+        TRACER.clear()  # the file should hold exactly this run's kernels
     dims = 1 << 22
     batch = 16384
     width = 32
@@ -112,6 +132,12 @@ def main():
 
     lane = jnp.ones_like(idx, jnp.int8)
     report("touched max int8", touch_max, touched, idx, lane)
+
+    if args.trace_out:
+        doc = TRACER.export_chrome(args.trace_out)
+        print(f"wrote {len(doc['traceEvents'])} trace events "
+              f"({doc['otherData']['traces']} kernels) to {args.trace_out} "
+              f"— load in ui.perfetto.dev")
 
 
 if __name__ == "__main__":

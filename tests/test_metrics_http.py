@@ -57,12 +57,75 @@ def test_histogram_snapshot_and_quantile():
     assert snap["sum"] == pytest.approx(106.6)
     assert snap["buckets"] == [(1.0, 1), (2.0, 3), (4.0, 4),
                                (float("inf"), 5)]
-    assert h.quantile(0.5) == 2.0  # 3rd of 5 falls in the <=2.0 bucket
+    # rank 2.5 of 5 lands in the (1, 2] bucket which holds obs 2..3:
+    # interpolated 1 + (2-1) * (2.5-1)/2 = 1.75, NOT the bucket's ceiling
+    assert h.quantile(0.5) == pytest.approx(1.75)
     # the plain snapshot() dict exports count/sum for legacy consumers
     flat = REGISTRY.snapshot()
     REGISTRY.histogram("flat.check").observe(1.0)
     flat = REGISTRY.snapshot()
     assert flat["flat.check.count"] == 1.0
+
+
+def test_histogram_quantile_interpolates_within_bucket():
+    """The pre-interpolation quantile() returned the holding bucket's
+    UPPER BOUND — values clustered near a bucket floor over-reported by up
+    to the whole bucket width (p50 of a hundred 1.1s in a (1, 4] bucket
+    read 4.0). Pinned: the estimate now scales linearly with rank inside
+    the bucket, clamps the +Inf overflow to the largest finite bound, and
+    stays exact at bucket edges."""
+    from hivemall_tpu.runtime.metrics import Histogram
+
+    h = Histogram("q", buckets=(1.0, 4.0, 8.0))
+    for _ in range(100):
+        h.observe(1.1)  # all mass just above the (1, 4] bucket's floor
+    # ranks spread linearly across the holding bucket, not pinned at 4.0
+    assert h.quantile(0.5) == pytest.approx(1.0 + 3.0 * 0.5)
+    assert h.quantile(0.95) == pytest.approx(1.0 + 3.0 * 0.95)
+    assert h.quantile(1.0) == pytest.approx(4.0)
+    # first bucket interpolates from 0
+    h2 = Histogram("q2", buckets=(2.0, 4.0))
+    for _ in range(10):
+        h2.observe(0.5)
+    assert h2.quantile(0.5) == pytest.approx(1.0)
+    # overflow ranks clamp to the largest finite bound (JSON-safe)
+    h3 = Histogram("q3", buckets=(1.0, 2.0))
+    for _ in range(4):
+        h3.observe(50.0)
+    assert h3.quantile(0.99) == 2.0
+    # empty histogram stays 0
+    assert Histogram("q4", buckets=(1.0,)).quantile(0.9) == 0.0
+
+
+def test_histogram_exemplar_attachment():
+    """observe(value, trace_id=...) pins the last sampled observation per
+    bucket as an OpenMetrics-shaped exemplar; unsampled observations leave
+    exemplars untouched; the typed registry snapshot and the ?exemplars=1
+    exposition carry them."""
+    from hivemall_tpu.runtime.metrics import Histogram
+
+    h = REGISTRY.histogram("exemplar.check", buckets=(0.1, 1.0))
+    h.observe(0.05)                       # unsampled: no exemplar
+    assert h.exemplars() == {}
+    h.observe(0.07, trace_id="t_fast")
+    h.observe(0.5, trace_id="t_mid")
+    h.observe(0.6, trace_id="t_mid2")     # same bucket: last one wins
+    h.observe(50.0, trace_id="t_slow")    # +Inf overflow bucket
+    ex = h.exemplars()
+    assert ex[0.1]["trace_id"] == "t_fast"
+    assert ex[0.1]["value"] == pytest.approx(0.07)
+    assert ex[1.0]["trace_id"] == "t_mid2"
+    assert ex[float("inf")]["trace_id"] == "t_slow"
+    typed = REGISTRY.typed_snapshot()
+    assert typed["histograms"]["exemplar.check"]["exemplars"][1.0][
+        "trace_id"] == "t_mid2"
+    # default exposition stays exemplar-free (0.0.4 text format); the
+    # OpenMetrics suffix renders on request and names the trace
+    plain = render_prometheus()
+    assert "t_mid2" not in plain
+    rich = render_prometheus(exemplars=True)
+    assert '# {trace_id="t_mid2"}' in rich
+    assert 'le="+Inf"' in rich
 
 
 def test_live_scrape_and_health():

@@ -161,15 +161,19 @@ def test_undeploy(stack):
     assert e.value.code == 404
 
 
-def test_bench_serving_http_mode_smoke():
+def test_bench_serving_http_mode_smoke(tmp_path):
     """scripts/bench_serving.py --http drives POST /predict end-to-end
     (ROADMAP open item): same BENCH-style JSON, zero steady-state
-    recompiles and a zero-failure hot swap at the HTTP surface."""
+    recompiles, a zero-failure hot swap at the HTTP surface, and the
+    tracing artifact — a Chrome trace covering >= 4 request-path stages
+    plus the per-stage breakdown embedded in the BENCH JSON."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trace_path = str(tmp_path / "serving_trace.json")
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     proc = subprocess.run(
         [sys.executable, "scripts/bench_serving.py", "--http", "--smoke",
-         "--requests", "80", "--train-rows", "150", "--concurrency", "2"],
+         "--requests", "80", "--train-rows", "150", "--concurrency", "2",
+         "--trace-out", trace_path],
         cwd=repo, env=env, capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     line = [ln for ln in proc.stdout.splitlines()
@@ -183,6 +187,18 @@ def test_bench_serving_http_mode_smoke():
     assert result["request_errors"] == 0
     assert {m["metric"] for m in result["extra_metrics"]} == {
         "http_p50_ms", "http_p95_ms", "http_p99_ms"}
+    # the tracing block: per-stage breakdown + slowest traces in the
+    # artifact, and the exported Chrome trace loads with the full request
+    # stage vocabulary (server/queue/pad/dispatch/block)
+    tr = result["tracing"]
+    assert len(set(tr["distinct_stages"]) & {
+        "server.predict", "queue.wait", "engine.pad", "engine.dispatch",
+        "engine.block"}) >= 4
+    assert tr["slowest_traces"] and tr["slowest_traces"][0]["stages_ms"]
+    assert tr["stage_breakdown_ms"]["queue.wait"]["count"] > 0
+    doc = json.load(open(trace_path))
+    assert {e["name"] for e in doc["traceEvents"]} >= set(
+        tr["distinct_stages"])
 
 
 def test_multi_model_registry(stack):

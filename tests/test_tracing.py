@@ -1,0 +1,529 @@
+"""End-to-end tracing tests (runtime/tracing.py + the serving/training
+wiring): span nesting and the batcher thread hop, ring-buffer eviction,
+seeded sampling determinism, Chrome-export schema, the /trace endpoint,
+recompile instant events, per-step training timelines, and the tracer's
+hot-path overhead bound."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.runtime.tracing import (TRACER, Tracer, step_span,
+                                          sync_ready)
+
+
+def _make_model(dims=256, n=120, seed=0):
+    from hivemall_tpu.models.classifier import train_arow
+
+    rng = np.random.RandomState(seed)
+    rows = [[f"{rng.randint(dims)}:{rng.rand():.3f}"
+             for _ in range(rng.randint(3, 8))] for _ in range(n)]
+    labels = rng.choice([-1, 1], n)
+    return train_arow(rows, labels, f"-dims {dims}"), rows
+
+
+# -- core span mechanics -----------------------------------------------------
+
+def test_span_nesting_and_parenting():
+    t = Tracer(seed=1)
+    with t.span("root", args={"k": 1}) as root:
+        assert t.current() is root
+        with t.span("child") as child:
+            assert child.trace_id == root.trace_id
+            with t.span("grandchild") as gc:
+                pass
+    assert t.current() is None
+    (trace,) = t.traces()
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert trace["root"] == "root"
+    assert by_name["child"]["parent_id"] == by_name["root"]["span_id"]
+    assert by_name["grandchild"]["parent_id"] == by_name["child"]["span_id"]
+    assert by_name["root"]["parent_id"] is None
+    assert by_name["root"]["args"] == {"k": 1}
+    assert trace["duration_ms"] >= by_name["child"]["dur_us"] / 1e3
+
+
+def test_sibling_roots_are_separate_traces():
+    t = Tracer(seed=1)
+    with t.span("a"):
+        pass
+    with t.span("b"):
+        pass
+    ids = [tr["trace_id"] for tr in t.traces()]
+    assert len(ids) == 2 and ids[0] != ids[1]
+
+
+def test_disabled_tracer_is_a_noop():
+    t = Tracer(enabled=False)
+    with t.span("x") as s:
+        assert not s.recording
+        s.set(a=1)
+        s.event("e")
+    assert t.traces() == []
+    assert t.current() is None
+
+
+def test_traces_n_zero_returns_none_not_all():
+    """out[-0:] is the whole list — n<=0 must mean 'none', including via
+    GET /trace?n=0."""
+    t = Tracer(seed=0)
+    for i in range(3):
+        with t.span(f"r{i}"):
+            pass
+    assert t.traces(n=0) == []
+    assert t.traces(n=-2) == []
+    assert len(t.traces(n=2)) == 2
+
+
+def test_ring_buffer_eviction_order():
+    """The ring holds the LAST `capacity` committed traces, oldest first —
+    FIFO eviction, no reordering."""
+    t = Tracer(capacity=3, seed=0)
+    for i in range(7):
+        with t.span(f"r{i}"):
+            pass
+    assert [tr["root"] for tr in t.traces()] == ["r4", "r5", "r6"]
+    assert [tr["root"] for tr in t.traces(n=2)] == ["r5", "r6"]
+    t.clear()
+    assert t.traces() == []
+
+
+def test_sampling_determinism_with_seeded_sampler():
+    """Same seed -> the same commit/drop decision sequence (roots draw
+    from a seeded RNG); child spans inherit the root's decision."""
+    def decisions(seed):
+        t = Tracer(sample_rate=0.4, seed=seed)
+        out = []
+        for i in range(32):
+            with t.span(f"r{i}") as root:
+                with t.span("child"):
+                    pass
+                out.append(root.sampled)
+        # committed traces == sampled roots, in order
+        assert [tr["root"] for tr in t.traces()] == \
+            [f"r{i}" for i, s in enumerate(out) if s]
+        return out
+
+    a, b = decisions(1234), decisions(1234)
+    assert a == b
+    assert 0 < sum(a) < 32  # actually sampling, not all-or-nothing
+    assert decisions(99) != a  # seed matters
+
+
+def test_always_sample_on_slow():
+    """An unsampled root slower than slow_ms commits anyway — the tail is
+    never invisible; fast unsampled roots count as dropped."""
+    t = Tracer(sample_rate=0.0, slow_ms=5.0, seed=0)
+    with t.span("fast"):
+        pass
+    with t.span("slow"):
+        time.sleep(0.02)
+    roots = [tr["root"] for tr in t.traces()]
+    assert roots == ["slow"]
+    assert t.traces()[0]["sampled"] is False
+    assert t.dropped == 1
+
+
+def test_exemplar_id_respects_sampling_and_slow_escape():
+    """Exemplars link only to traces that can land in the ring: sampled
+    roots always; unsampled roots only when slow_ms makes the slow escape
+    possible (the tail is exactly what an exemplar should reach)."""
+    t = Tracer(sample_rate=0.0, seed=0)
+    with t.span("r") as root:
+        assert t.exemplar_id(root) is None  # can never commit
+    t_slow = Tracer(sample_rate=0.0, slow_ms=1.0, seed=0)
+    with t_slow.span("r") as root:
+        assert t_slow.exemplar_id(root) == root.trace_id
+        time.sleep(0.002)
+    assert [tr["trace_id"] for tr in t_slow.traces()] == [root.trace_id]
+    t_on = Tracer(sample_rate=1.0, seed=0)
+    with t_on.span("r") as root:
+        assert t_on.exemplar_id() == root.trace_id  # defaults to current
+    assert t_on.exemplar_id() is None  # outside any span
+
+
+def test_instant_events_and_retro_spans():
+    t = Tracer(seed=0)
+    with t.span("root") as root:
+        t0 = time.perf_counter_ns()
+        time.sleep(0.001)
+        t.instant("marker", {"x": 1})
+        t.add_span("retro", root, t0, time.perf_counter_ns(),
+                   args={"rows": 3})
+    (trace,) = t.traces()
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert by_name["root"]["events"][0]["name"] == "marker"
+    assert by_name["root"]["events"][0]["args"] == {"x": 1}
+    assert by_name["retro"]["parent_id"] == by_name["root"]["span_id"]
+    assert by_name["retro"]["dur_us"] >= 1000
+    assert by_name["retro"]["args"] == {"rows": 3}
+
+
+def test_chrome_export_schema(tmp_path):
+    """The export is Chrome trace_event JSON: a traceEvents list of "X"
+    complete events (ts/dur in microseconds) and "i" instant events, each
+    carrying pid/tid and the trace/span ids in args — the shape
+    ui.perfetto.dev and chrome://tracing load."""
+    t = Tracer(seed=0)
+    with t.span("root", args={"rows": 4}):
+        with t.span("child"):
+            t.instant("blip", {"n": 1})
+    path = str(tmp_path / "trace.json")
+    doc = t.export_chrome(path)
+    on_disk = json.load(open(path))
+    assert on_disk == doc
+    assert set(doc) >= {"traceEvents", "displayTimeUnit"}
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    instants = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert {e["name"] for e in xs} == {"root", "child"}
+    assert [e["name"] for e in instants] == ["blip"]
+    for e in xs:
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert e["cat"] == "hivemall_tpu"
+        assert "trace_id" in e["args"] and "span_id" in e["args"]
+    (blip,) = instants
+    assert blip["s"] == "t"
+    root = next(e for e in xs if e["name"] == "root")
+    child = next(e for e in xs if e["name"] == "child")
+    assert child["args"]["parent_id"] == root["args"]["span_id"]
+    # spans nest in time: child inside [root.ts, root.ts + root.dur]
+    assert root["ts"] <= child["ts"]
+    assert child["ts"] + child["dur"] <= root["ts"] + root["dur"] + 1e-3
+
+
+def test_stage_breakdown_and_slowest():
+    t = Tracer(seed=0)
+    for ms in (1, 5):
+        with t.span("request"):
+            with t.span("work"):
+                time.sleep(ms / 1000)
+    br = t.stage_breakdown()
+    assert br["work"]["count"] == 2
+    assert br["work"]["total_ms"] >= 5.0
+    assert br["work"]["max_ms"] >= br["work"]["mean_ms"]
+    slowest = t.slowest(1)
+    assert len(slowest) == 1
+    assert slowest[0]["duration_ms"] >= 5.0
+    assert slowest[0]["stages_ms"]["work"] >= 5.0
+
+
+def test_jax_annotation_bridge():
+    """jax_annotations=True wraps each span extent in a
+    jax.profiler.TraceAnnotation — same span names in xprof timelines;
+    tracing semantics are unchanged."""
+    t = Tracer(seed=0, jax_annotations=True)
+    with t.span("annotated"):
+        with t.span("inner"):
+            pass
+    (trace,) = t.traces()
+    assert {s["name"] for s in trace["spans"]} == {"annotated", "inner"}
+
+
+# -- serving-path wiring -----------------------------------------------------
+
+def test_batcher_thread_hop_parenting():
+    """A request submitted under an ambient span crosses to the worker
+    thread carrying it: queue.wait and batch.predict land in the SAME
+    trace, parented under the submit-side span."""
+    from hivemall_tpu.serving import DynamicBatcher
+
+    TRACER.clear()
+    batcher = DynamicBatcher(lambda rows: [0.0] * len(rows),
+                             name="hop_test", max_delay_ms=1.0)
+    try:
+        with TRACER.span("server.predict") as root:
+            fut = batcher.submit([["1:1.0"], ["2:1.0"]])
+            assert fut.result(timeout=10) == [0.0, 0.0]
+    finally:
+        batcher.close()
+    trace = next(t for t in TRACER.traces()
+                 if t["root"] == "server.predict")
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert {"server.predict", "queue.wait", "batch.predict"} <= set(by_name)
+    root_id = by_name["server.predict"]["span_id"]
+    assert by_name["queue.wait"]["parent_id"] == root_id
+    assert by_name["batch.predict"]["parent_id"] == root_id
+    # the hop is real: worker spans ran on a different thread
+    assert by_name["batch.predict"]["tid"] != by_name["server.predict"]["tid"]
+    assert by_name["queue.wait"]["args"]["rows"] == 2
+
+
+def test_batch_rep_prefers_sampled_request():
+    """Under sampling < 1, the batch's device-side spans must land in a
+    trace that will actually COMMIT: an unsampled first request must not
+    absorb batch.predict into a dropped trace while the sampled request
+    commits stage-less (regression: rep selection ignored sampling)."""
+    import hivemall_tpu.serving.batcher as batcher_mod
+    from hivemall_tpu.serving import DynamicBatcher
+
+    t = Tracer(sample_rate=0.5, seed=7)
+    # find a (drop, keep) decision pair so request 0 is unsampled
+    probe = Tracer(sample_rate=0.5, seed=7)
+    decisions = [probe._sample() for _ in range(8)]
+    assert False in decisions and True in decisions
+    orig = batcher_mod.TRACER
+    batcher_mod.TRACER = t
+    try:
+        b = DynamicBatcher(lambda rows: [0.0] * len(rows),
+                           name="rep_test", max_batch=64,
+                           max_delay_ms=50.0)
+        # stall the worker so all submits merge into one batch
+        gate = b.submit([["0:1.0"]])
+        futs = [b.submit([[f"{i}:1.0"]]) for i in range(1, 8)]
+        for f in [gate] + futs:
+            f.result(timeout=10)
+        time.sleep(0.1)  # done-callbacks commit the owned roots
+        b.close()
+    finally:
+        batcher_mod.TRACER = orig
+    committed = t.traces()
+    assert committed, "sampling 0.5 over 8 requests must commit some"
+    # every committed multi-request batch trace that carries the device
+    # call carries it fully; and at least one committed trace has it
+    assert any(any(s["name"] == "batch.predict" for s in tr["spans"])
+               for tr in committed)
+    for tr in committed:
+        names = [s["name"] for s in tr["spans"]]
+        # a committed request trace either owns the batch dispatch or
+        # links to the trace that does — never silently stage-less
+        if "batch.predict" not in names:
+            events = [e for s in tr["spans"] for e in s["events"]]
+            assert any(e["name"] == "batched" for e in events)
+
+
+def test_batcher_owns_root_when_no_ambient_span():
+    """submit() with no open span starts its own serving.request root and
+    the future's done-callback ends it — direct batcher users get traces
+    too."""
+    from hivemall_tpu.serving import DynamicBatcher
+
+    TRACER.clear()
+    batcher = DynamicBatcher(lambda rows: [1.0] * len(rows),
+                             name="own_root", max_delay_ms=1.0)
+    try:
+        batcher.submit([["1:1.0"]]).result(timeout=10)
+        deadline = time.time() + 5
+        while not TRACER.traces() and time.time() < deadline:
+            time.sleep(0.005)  # done-callback commits just after result()
+    finally:
+        batcher.close()
+    trace = next(t for t in TRACER.traces()
+                 if t["root"] == "serving.request")
+    names = {s["name"] for s in trace["spans"]}
+    assert {"serving.request", "queue.wait", "batch.predict"} <= names
+
+
+def test_engine_stage_spans_and_latency_exemplar():
+    """engine.predict emits the bucket/pad/dispatch/block stages under its
+    umbrella span, and its latency histogram observation carries the
+    trace_id as an exemplar."""
+    from hivemall_tpu.runtime.metrics import REGISTRY
+    from hivemall_tpu.serving import ServingEngine
+
+    model, rows = _make_model()
+    engine = ServingEngine(model, name="trace_eng", max_batch=16,
+                           max_width=16)
+    engine.warmup()
+    TRACER.clear()
+    engine.predict(rows[:4])
+    trace = next(t for t in TRACER.traces()
+                 if t["root"] == "engine.predict")
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert {"engine.predict", "engine.bucket", "engine.pad",
+            "engine.dispatch", "engine.block"} <= set(by_name)
+    umbrella = by_name["engine.predict"]["span_id"]
+    for stage in ("engine.bucket", "engine.pad"):
+        assert by_name[stage]["parent_id"] == umbrella
+    assert by_name["engine.bucket"]["args"]["b_pad"] == 8
+    ex = REGISTRY.histogram("serving.trace_eng.predict_seconds").exemplars()
+    assert any(e["trace_id"] == trace["trace_id"] for e in ex.values())
+
+
+def test_recompile_instant_event_lands_inside_span():
+    """A jit cache miss under recompile_guard inside an open span surfaces
+    as a jit_recompile instant event in that trace — the recompile shows
+    up inside the request/step that paid for it."""
+    import jax
+
+    from hivemall_tpu.runtime.metrics import recompile_guard
+
+    fresh = jax.jit(lambda x: x * 3 + 1)
+    t_local = TRACER
+    t_local.clear()
+    with t_local.span("request"):
+        with recompile_guard("tracing_test_compile", fresh):
+            fresh(np.float32(2.0))
+    trace = next(t for t in t_local.traces() if t["root"] == "request")
+    events = [e for s in trace["spans"] for e in s["events"]]
+    assert any(e["name"] == "jit_recompile"
+               and e["args"]["guard"] == "tracing_test_compile"
+               and e["args"]["compiles"] >= 1 for e in events)
+
+
+def test_trace_endpoint_smoke():
+    """GET /trace?n= serves the ring as Chrome JSON on the metrics port
+    (and the serving server inherits it)."""
+    from hivemall_tpu.runtime.metrics_http import serve_metrics
+
+    TRACER.clear()
+    with TRACER.span("endpoint.root"):
+        with TRACER.span("endpoint.child"):
+            pass
+    server = serve_metrics(port=0)
+    try:
+        port = server.server_address[1]
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/trace?n=5", timeout=10).read())
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert {"endpoint.root", "endpoint.child"} <= names
+        # bad n falls back instead of erroring
+        doc2 = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/trace?n=bogus", timeout=10).read())
+        assert "traceEvents" in doc2
+    finally:
+        server.shutdown()
+
+
+def test_http_predict_root_span_end_to_end():
+    """POST /predict produces one trace whose stages cover the whole path:
+    server root + parse, queue wait, batched dispatch, engine stages —
+    the >= 4 distinct-stage acceptance shape."""
+    from hivemall_tpu.serving import ModelRegistry
+    from hivemall_tpu.serving.server import serve
+
+    model, rows = _make_model(seed=3)
+    registry = ModelRegistry(max_delay_ms=1.0,
+                             engine_kwargs={"max_batch": 16,
+                                            "max_width": 16})
+    registry.deploy("m", model, version="1")
+    server = serve(registry)
+    try:
+        port = server.server_address[1]
+        TRACER.clear()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"model": "m",
+                             "instances": rows[:3]}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        assert len(out["predictions"]) == 3
+    finally:
+        server.shutdown()
+        registry.shutdown()
+    trace = next(t for t in TRACER.traces()
+                 if t["root"] == "server.predict")
+    names = {s["name"] for s in trace["spans"]}
+    assert len(names & {"server.predict", "queue.wait", "engine.pad",
+                        "engine.dispatch", "engine.block"}) >= 4
+    root = next(s for s in trace["spans"] if s["name"] == "server.predict")
+    assert root["args"]["status"] == 200
+    assert root["args"]["instances"] == 3
+
+
+# -- training wiring ---------------------------------------------------------
+
+def test_step_span_times_training_phases():
+    """The per-step training timeline: step_span root, trainer dispatch as
+    train.compiled_step, host block building as train.data_prep,
+    sync_ready as train.sync — all one trace per step."""
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.parallel import MixConfig, MixTrainer, make_mesh
+
+    tr = MixTrainer(AROW, {"r": 0.1}, 512, make_mesh(2), MixConfig())
+    state = tr.init()
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, 512, (2, 8, 4)).astype(np.int32)
+    val = np.ones((2, 8, 4), np.float32)
+    lab = np.sign(rng.randn(2, 8)).astype(np.float32)
+    TRACER.clear()
+    for i in range(2):
+        with step_span("mix_dp", step=i):
+            blocks = tr.shard_blocks(idx, val, lab)
+            state, loss = tr.step(state, *blocks)
+            sync_ready(loss)
+    steps = [t for t in TRACER.traces() if t["root"] == "train.step"]
+    assert len(steps) == 2
+    for want_step, trace in enumerate(steps):
+        by_name = {s["name"]: s for s in trace["spans"]}
+        assert {"train.step", "train.data_prep", "train.compiled_step",
+                "train.sync"} <= set(by_name)
+        root = by_name["train.step"]
+        assert root["args"] == {"trainer": "mix_dp", "step": want_step}
+        for child in ("train.data_prep", "train.compiled_step",
+                      "train.sync"):
+            assert by_name[child]["parent_id"] == root["span_id"]
+        assert by_name["train.compiled_step"]["args"]["trainer"] == "mix_dp"
+
+
+def test_sharded_trainer_step_is_spanned():
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.parallel import make_mesh
+    from hivemall_tpu.parallel.sharded_train import ShardedTrainer
+
+    tr = ShardedTrainer(AROW, {"r": 0.1}, 600, make_mesh(2))
+    state = tr.init()
+    idx = np.zeros((8, 4), np.int32)
+    val = np.ones((8, 4), np.float32)
+    lab = np.ones(8, np.float32)
+    TRACER.clear()
+    with step_span("sharded_1d", step=0):
+        state, _ = tr.step(state, idx, val, lab)
+    tr.final_state(state)  # train.sync, its own root outside the step
+    roots = [t["root"] for t in TRACER.traces()]
+    assert "train.step" in roots and "train.sync" in roots
+    step_trace = next(t for t in TRACER.traces()
+                      if t["root"] == "train.step")
+    names = {s["name"] for s in step_trace["spans"]}
+    assert "train.compiled_step" in names
+
+
+# -- overhead ----------------------------------------------------------------
+
+def test_tracer_overhead_under_5_percent():
+    """Closed-loop throughput with full tracing (sampling 1.0, the
+    serving span shape: root + 3 children per iteration) must stay within
+    5% of tracing disabled. The workload is a ~2 ms spin — comparable to
+    a real padded CPU dispatch and large enough that per-iteration span
+    cost (a few microseconds) is far below the 5% bound; best-of
+    interleaved trials absorbs scheduler noise."""
+    def spin():  # deterministic CPU-bound work, no syscalls
+        acc = 0
+        for i in range(60000):
+            acc += i * i
+        return acc
+
+    def run(tracer, iters=60):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with tracer.span("request"):
+                with tracer.span("stage_a"):
+                    spin()
+                with tracer.span("stage_b"):
+                    spin()
+                with tracer.span("stage_c"):
+                    spin()
+        return iters / (time.perf_counter() - t0)
+
+    on = Tracer(capacity=64, sample_rate=1.0, seed=0)
+    off = Tracer(enabled=False)
+    run(on, iters=10), run(off, iters=10)  # warm caches
+    # PAIRED back-to-back trials, alternating order to cancel drift; the
+    # verdict is the least-noisy pair's delta. This box's inter-trial
+    # throughput swings far exceed 5% (shared cores), so unpaired
+    # medians/bests flake — but a genuinely slow tracer (say 20%
+    # overhead) shows >5% in EVERY pair, which still fails.
+    deltas = []
+    for trial in range(6):
+        if trial % 2 == 0:
+            r_on, r_off = run(on), run(off)
+        else:
+            r_off, r_on = run(off), run(on)
+        deltas.append((r_off - r_on) / r_off)
+    delta = min(deltas)
+    assert delta < 0.05, (f"tracing overhead {delta:.1%} in the best "
+                          f"pairing (all pairs: "
+                          f"{[f'{d:.1%}' for d in deltas]})")
