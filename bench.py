@@ -145,6 +145,16 @@ def _measure() -> None:
         "platform": platform,
         "arow_rows_per_sec": round(arow_rps, 1),
         "fm_rows_per_sec": round(fm_rps, 1),
+        # the mesh/device set the measurement ACTUALLY got — rounds on
+        # degraded hosts (r03-r05 ran on CPU fallback after relay-probe
+        # failures) stay attributable and comparable in the BENCH record
+        "device_set": {
+            "platform": platform,
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "process_count": jax.process_count(),
+            "device_kinds": sorted({d.device_kind for d in jax.devices()}),
+        },
     }
     if platform == "tpu":
         # A/B the sorted-window MXU update backend (ops/mxu_scatter.py) in
@@ -303,7 +313,10 @@ def main() -> None:
         raw = _run_child(dict(SCRUB_ENV), timeout=1200)
     if raw is None:
         raw = {"platform": "none", "arow_rows_per_sec": 0.0,
-               "fm_rows_per_sec": 0.0}
+               "fm_rows_per_sec": 0.0,
+               "device_set": {"platform": "none", "device_count": 0,
+                              "local_device_count": 0, "process_count": 0,
+                              "device_kinds": []}}
 
     try:
         anchors = _measure_anchors()
@@ -324,6 +337,7 @@ def main() -> None:
         "unit": "rows/sec",
         "vs_baseline": round(arow / arow_anchor, 3) if arow_anchor else 0.0,
         "platform": raw.get("platform", "none"),
+        "device_set": raw.get("device_set"),
         "methodology": "hbm_staged_device_scan_epoch",
         "baseline_anchor": anchors,
         "vs_estimated_jvm_mapper": round(

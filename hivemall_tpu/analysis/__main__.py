@@ -80,10 +80,12 @@ def main(argv: List[str] = None) -> int:
         description="graftcheck: JAX/TPU-aware static analysis "
                     "(recompile / host-sync / dtype / axis / donation / "
                     "side-effect hazards, interprocedural SPMD/collective "
-                    "safety G007-G011, and concurrency/serving safety "
-                    "G012-G016 — lock discipline, blocking-under-lock, CV "
-                    "misuse, thread leaks, lock-order cycles — with a "
-                    "--fix autofix engine and SARIF output)")
+                    "safety G007-G011, concurrency/serving safety "
+                    "G012-G016, and dtype/precision flow G017-G021 — "
+                    "silent hot-path promotion, f64 serving leaks, "
+                    "cast-in-loop dequant, artifact dtype round-trips, "
+                    "low-precision accumulation — with a --fix autofix "
+                    "engine and SARIF output)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: hivemall_tpu)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -98,6 +100,12 @@ def main(argv: List[str] = None) -> int:
                     default="text",
                     help="sarif emits SARIF 2.1.0 of the non-baselined "
                          "findings for CI annotations")
+    ap.add_argument("--output", default=None, metavar="FILE",
+                    help="write the --format payload to FILE instead of "
+                         "stdout; stdout then keeps the human-readable "
+                         "text rendering (so the CI gate can archive a "
+                         "SARIF artifact without losing the console "
+                         "summary)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--fix", action="store_true",
                     help="apply machine-applicable fixes (with a unified-"
@@ -110,6 +118,15 @@ def main(argv: List[str] = None) -> int:
                          "import the given paths — interprocedural rules "
                          "can fire in an unchanged caller")
     args = ap.parse_args(argv)
+
+    if args.output is not None:
+        # a silently-unwritten artifact is worse than a usage error: a CI
+        # step would upload a stale file from a previous run
+        if args.format == "text":
+            ap.error("--output requires --format sarif or --format json")
+        if args.fix or args.fix_check or args.update_baseline:
+            ap.error("--output applies to report runs only, not "
+                     "--fix/--fix-check/--update-baseline")
 
     if args.list_rules:
         from .rules import RULE_DOCS
@@ -167,31 +184,46 @@ def main(argv: List[str] = None) -> int:
         new, stale = diff_against_baseline(findings, load_baseline(
             args.baseline), scanned_paths=scanned)
 
+    payload = None
     if args.format == "sarif":
         from .sarif import render_sarif
-        print(json.dumps(render_sarif(new), indent=1))
+        payload = json.dumps(render_sarif(new), indent=1)
     elif args.format == "json":
-        print(json.dumps({
+        payload = json.dumps({
             "new": [f.to_dict() for f in new],
             "stale": [f.to_dict() for f in stale],
             "total": len(findings),
-        }, indent=1))
+        }, indent=1)
+    if payload is not None and args.output is not None:
+        # archive the machine payload, keep the console human-readable —
+        # the CI gate uploads the file as an annotation artifact while the
+        # log still shows the findings
+        with open(args.output, "w", encoding="utf-8") as fh:
+            fh.write(payload + "\n")
+        _print_text(new, stale, findings)
+        print(f"graftcheck: {args.format} written to {args.output}")
+    elif payload is not None:
+        print(payload)
     else:
-        for f in new:
-            print(f.format())
-        for b in stale:
-            print(f"note: stale baseline entry ({b.rule} {b.path}: "
-                  f"{b.snippet!r}) — refresh with --update-baseline")
-        n_err = sum(1 for f in new if f.severity == Severity.ERROR)
-        n_warn = len(new) - n_err
-        if new:
-            print(f"graftcheck: {n_err} error(s), {n_warn} warning(s) not "
-                  f"in baseline ({len(findings)} total findings)")
-        else:
-            print(f"graftcheck: clean ({len(findings)} baselined finding(s)"
-                  f", {len(stale)} stale)" if (findings or stale)
-                  else "graftcheck: clean")
+        _print_text(new, stale, findings)
     return 1 if new else 0
+
+
+def _print_text(new, stale, findings) -> None:
+    for f in new:
+        print(f.format())
+    for b in stale:
+        print(f"note: stale baseline entry ({b.rule} {b.path}: "
+              f"{b.snippet!r}) — refresh with --update-baseline")
+    n_err = sum(1 for f in new if f.severity == Severity.ERROR)
+    n_warn = len(new) - n_err
+    if new:
+        print(f"graftcheck: {n_err} error(s), {n_warn} warning(s) not "
+              f"in baseline ({len(findings)} total findings)")
+    else:
+        print(f"graftcheck: clean ({len(findings)} baselined finding(s)"
+              f", {len(stale)} stale)" if (findings or stale)
+              else "graftcheck: clean")
 
 
 if __name__ == "__main__":

@@ -116,6 +116,38 @@ BLOCKING_JIT_TAILS = ("warmup", "predict", "predict_fn")
 BLOCKING_SAFE_ROOTS = ("os", "np", "numpy", "json", "re", "posixpath",
                        "ntpath", "shutil", "sys", "math")
 
+# --- G017-G021: dtype / precision flow (v4) ---------------------------------
+# Hot-path scopes for the dtype-flow rules: a silent widening here doubles
+# HBM traffic on every step/request (the dequant-free serving contract the
+# quantized-artifact work depends on). The kernel/op packages and the
+# serving score path are always hot; elsewhere in the dtype-sensitive
+# packages only traced / step-shaped functions are (dtypeflow.in_hot_scope).
+DTYPEFLOW_HOT_PREFIXES = (
+    "hivemall_tpu/ops/",
+    "hivemall_tpu/kernels/",
+)
+DTYPEFLOW_HOT_MODULES = ("hivemall_tpu/serving/engine.py",)
+HOT_MARKER = "# graftcheck: hot-module"
+
+# G018 scope: the serving/request path plus checkpoint IO — np.float64 (or a
+# float64-by-default numpy constructor) here silently doubles payload and
+# table bandwidth. Modules outside opt in with the serving-module marker
+# (shared with G013 — both guard the same request path).
+DTYPEFLOW_SERVING_PREFIXES = (
+    "hivemall_tpu/serving/",
+    "hivemall_tpu/io/",
+)
+
+# G020 scope: artifact/checkpoint save->load modules whose reloads must pin
+# the manifest dtype (a bf16 table widened to f32 at rest must narrow back
+# on load, not silently serve wide).
+ARTIFACT_IO_MODULES = (
+    "hivemall_tpu/io/checkpoint.py",
+    "hivemall_tpu/serving/artifact.py",
+    "hivemall_tpu/serving/engine.py",
+)
+ARTIFACT_MARKER = "# graftcheck: artifact-io"
+
 # --- G005: donation --------------------------------------------------------
 # jit-wrapped functions whose name looks step-shaped should donate their
 # model-state argument; otherwise every hot-loop step copies the tables.

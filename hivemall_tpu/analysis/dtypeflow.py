@@ -1,0 +1,718 @@
+"""Dtype & weak-type flow: the abstract interpreter behind G017-G021 (v4).
+
+Hivemall shipped a half-float codec because weight-table bytes are the
+serving bandwidth bottleneck; the quantized bf16/int8 artifact path this
+repo is heading toward (ROADMAP "raw speed") dies silently the moment a
+stray ``astype(jnp.float32)`` or a weak Python scalar re-promotes a reduced
+table. This module makes precision discipline *provable at lint time*: a
+dtype lattice propagated through ``jnp.*``/``np.*`` constructors,
+``astype``/``asarray`` sites, NumPy/JAX promotion semantics,
+``.at[...].add/set`` scatter updates, and depth-bounded call-return
+summaries over the whole-program model (analysis/program.py) — stdlib-only
+and jax-free like every other graftcheck layer.
+
+Abstract values (``DT``):
+
+- concrete dtypes: ``bool_``, ``int8..int64``/``uint8..uint64``,
+  ``bfloat16``, ``float16``, ``float32``, ``float64``;
+- **weak** Python scalars (``weak=True``): a bare ``2.0`` promotes by the
+  *other* operand's dtype under JAX semantics but re-promotes to f64 under
+  NumPy — so a weak value only stays provable against a concrete operand
+  of the same category;
+- ``None`` = unknown (parameters, unresolvable calls). Everything built on
+  this model flags only what it can prove; unknown is trusted, exactly
+  like G004 trusts dynamic axis names.
+
+Promotion is the *provable intersection* of NumPy and JAX semantics:
+where the two disagree (``int32 + float16`` widens to f32 under NumPy but
+stays f16 under JAX), the result is unknown — a rule can then never flag
+a mixing that one backend would have kept narrow.
+
+Per function, ``DtypeFlow.facts`` runs a flow-sensitive statement walk
+(loop bodies twice, If branches joined) and records the event classes the
+rules consume:
+
+- **promotions** — a binary op / binary ``jnp`` call whose operands'
+  concrete dtypes widen (G017's silent-promotion-in-hot-path evidence);
+- **casts** — every ``astype`` site with receiver/target dtypes, loop
+  enclosure, and receiver loop-invariance (G019);
+- **reductions** — ``sum``/``mean``/``cumsum``/``prod``/``segment_sum``
+  sites with the operand dtype and whether an explicit accumulator dtype
+  was given (G021);
+- **scatter updates** — ``table.at[...].add(...)`` sites with the table's
+  inferred dtype (G021's scatter-accumulate case).
+
+Call-return summaries make the walk interprocedural: a call to a
+resolvable def is evaluated by binding the caller's argument dtypes to
+the callee's parameters and joining the callee's ``return`` expression
+dtypes, depth-bounded and cycle-safe — so ``q = _load_quantized()`` three
+modules away still proves ``q`` is int8 at the mixing site.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+from .modmodel import _FN_TYPES, ModuleModel, dotted_name, enclosing_loop, \
+    walk_scope
+from .program import ProgramModel
+
+MAX_SUMMARY_DEPTH = 4
+
+
+class DT(NamedTuple):
+    """One abstract dtype: lattice point + weak (Python-scalar) flag."""
+
+    name: str       # "float32", "bfloat16", "int8", ... (numpy dtype name)
+    category: str   # "b" bool, "i" int, "u" uint, "f" float
+    bits: int
+    weak: bool = False
+
+    @property
+    def reduced_float(self) -> bool:
+        return self.category == "f" and self.bits < 32 and not self.weak
+
+    @property
+    def wide_float(self) -> bool:
+        return self.category == "f" and self.bits >= 32 and not self.weak
+
+
+_CONCRETE: Dict[str, DT] = {}
+for _name, _cat, _bits in (
+    ("bool_", "b", 8), ("int8", "i", 8), ("int16", "i", 16),
+    ("int32", "i", 32), ("int64", "i", 64), ("uint8", "u", 8),
+    ("uint16", "u", 16), ("uint32", "u", 32), ("uint64", "u", 64),
+    ("bfloat16", "f", 16), ("float16", "f", 16), ("float32", "f", 32),
+    ("float64", "f", 64),
+):
+    _CONCRETE[_name] = DT(_name, _cat, _bits)
+
+WEAK_FLOAT = DT("float64", "f", 64, weak=True)   # a bare Python float
+WEAK_INT = DT("int64", "i", 64, weak=True)       # a bare Python int
+
+# spelling aliases accepted wherever a dtype is named (attribute tails and
+# string literals): np.double, dtype="half", jnp.float_ ...
+_ALIASES = {
+    "double": "float64", "float_": "float64", "single": "float32",
+    "half": "float16", "bool": "bool_", "int": "int64", "float": "float64",
+    "bfloat16": "bfloat16", "intc": "int32", "byte": "int8", "ubyte": "uint8",
+}
+# module roots whose dtype attributes we trust (np.float32, jnp.bfloat16,
+# ml_dtypes.bfloat16)
+_DTYPE_ROOTS = ("np", "numpy", "jnp", "jax.numpy", "ml_dtypes")
+
+_NP_ROOTS = ("np", "numpy")
+_JNP_ROOTS = ("jnp", "jax.numpy")
+
+# array methods whose result keeps the receiver's dtype
+_PRESERVING_METHODS = (
+    "copy", "reshape", "ravel", "flatten", "transpose", "squeeze", "clip",
+    "round", "conj", "take", "repeat", "swapaxes", "block_until_ready",
+)
+# elementwise jnp/np calls whose result keeps the (promoted) operand dtype
+_ELEMENTWISE_CALLS = (
+    "exp", "log", "log1p", "expm1", "sqrt", "abs", "absolute", "tanh",
+    "sign", "negative", "square", "maximum", "minimum", "add", "subtract",
+    "multiply", "divide", "power", "where", "concatenate", "stack", "tile",
+    "pad", "roll", "flip", "sort", "dot", "matmul",
+)
+# binary calls checked for silent promotion alongside BinOp (G017)
+_BINARY_PROMOTING_CALLS = (
+    "maximum", "minimum", "add", "subtract", "multiply", "divide", "power",
+    "dot", "matmul",
+)
+# accumulating reductions whose accumulator defaults to the operand dtype
+# (the G021 class); matmul/dot are excluded — TPU MXU accumulates f32
+# internally regardless of the stored dtype
+REDUCTION_TAILS = ("sum", "nansum", "mean", "nanmean", "cumsum", "prod",
+                   "cumprod", "segment_sum")
+
+
+def join(a: Optional[DT], b: Optional[DT]) -> Optional[DT]:
+    """Lattice join for control-flow merges: equal or unknown."""
+    if a is None or b is None:
+        return None
+    return a if a == b else None
+
+
+def promote(a: Optional[DT], b: Optional[DT]) -> Optional[DT]:
+    """Result dtype of mixing two abstract values — only where NumPy and
+    JAX agree; None where they diverge or an input is unknown."""
+    if a is None or b is None:
+        return None
+    if a.weak and b.weak:
+        # float wins between weak scalars
+        return a if a.category == "f" or b.category != "f" else b
+    if a.weak or b.weak:
+        weak, conc = (a, b) if a.weak else (b, a)
+        if weak.category == "f" and conc.category in ("i", "u", "b"):
+            # np: f64; jax: default float — disagree
+            return None
+        # weak int + anything concrete, weak float + concrete float:
+        # both backends keep the concrete operand's dtype
+        return conc
+    if a.category == "f" and b.category == "f":
+        if a.name == b.name:
+            return a
+        if {a.name, b.name} == {"bfloat16", "float16"}:
+            return _CONCRETE["float32"]
+        return a if a.bits > b.bits else b
+    if a.category == b.category:
+        return a if a.bits >= b.bits else b
+    # int/uint/bool vs float: provable only when the float side is >= f32
+    # (np widens a reduced float against int32/int64; jax keeps it reduced)
+    fl, other = (a, b) if a.category == "f" else (b, a)
+    if fl.category != "f" or other.category not in ("i", "u", "b"):
+        return None  # int vs uint subtleties: unknown
+    if fl.bits >= 32 or other.bits <= 8:
+        return fl
+    return None
+
+
+def parse_dtype_name(name: str) -> Optional[DT]:
+    name = _ALIASES.get(name, name)
+    return _CONCRETE.get(name)
+
+
+class CastSite(NamedTuple):
+    node: ast.Call
+    receiver_dt: Optional[DT]
+    target_dt: Optional[DT]
+    loop: Optional[ast.AST]          # enclosing For/While, if any
+    loop_invariant: bool             # receiver not rebound inside that loop
+
+
+class PromotionSite(NamedTuple):
+    node: ast.AST
+    left_dt: DT
+    right_dt: DT
+    out_dt: DT
+
+
+class ReductionSite(NamedTuple):
+    node: ast.Call
+    tail: str
+    operand_dt: Optional[DT]
+    widened: bool                    # explicit dtype=/accumulator given
+
+
+class ScatterSite(NamedTuple):
+    node: ast.Call
+    method: str                      # add / set / mul / ...
+    table_dt: Optional[DT]
+
+
+class FnFacts:
+    """Everything the dtype rules need to know about one function."""
+
+    __slots__ = ("promotions", "casts", "reductions", "scatters",
+                 "return_dt", "_returned")
+
+    def __init__(self):
+        self.promotions: List[PromotionSite] = []
+        self.casts: List[CastSite] = []
+        self.reductions: List[ReductionSite] = []
+        self.scatters: List[ScatterSite] = []
+        self.return_dt: Optional[DT] = None
+        self._returned = False
+
+
+class DtypeFlow:
+    def __init__(self, program: ProgramModel):
+        self.program = program
+        self._facts: Dict[Tuple[str, int], FnFacts] = {}
+        self._returns: Dict[Tuple[str, int, tuple], Optional[DT]] = {}
+
+    # -- public ------------------------------------------------------------
+
+    def facts(self, path: str, fn: ast.AST) -> FnFacts:
+        key = (path, id(fn))
+        cached = self._facts.get(key)
+        if cached is None:
+            cached = self._analyze(path, fn, {}, collect=True,
+                                   depth=0, stack=set())
+            self._facts[key] = cached
+        return cached
+
+    # -- dtype-expression parsing ------------------------------------------
+
+    def dtype_of_dtype_expr(self, path: str, expr: ast.expr,
+                            env: Dict[str, Optional[DT]]) -> Optional[DT]:
+        """A dtype-position expression (astype arg, dtype= kwarg)."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return parse_dtype_name(expr.value)
+        name = dotted_name(expr)
+        if name is not None:
+            root, _, tail = name.rpartition(".")
+            if root in _DTYPE_ROOTS:
+                return parse_dtype_name(tail)
+            if root == "" and name in env:
+                return env[name]  # dt = jnp.bfloat16; x.astype(dt)
+            if root == "" and name == "float":
+                return _CONCRETE["float64"]  # astype(float) IS f64
+            if tail == "dtype":
+                # astype(y.dtype): follow y
+                return self._eval(path, expr.value, env, None, 0, set())
+        if isinstance(expr, ast.Call):
+            # jnp.dtype("bfloat16") / np.dtype(np.float32)
+            callee = dotted_name(expr.func) or ""
+            if callee.rsplit(".", 1)[-1] == "dtype" and expr.args:
+                return self.dtype_of_dtype_expr(path, expr.args[0], env)
+        return None
+
+    # -- call-return summaries ---------------------------------------------
+
+    def _return_dtype(self, path: str, fn: ast.AST,
+                      arg_dts: Dict[str, Optional[DT]], depth: int,
+                      stack: Set[Tuple[str, int]]) -> Optional[DT]:
+        key = (path, id(fn),
+               tuple(sorted((k, v) for k, v in arg_dts.items()
+                            if v is not None)))
+        if key in self._returns:
+            return self._returns[key]
+        if (path, id(fn)) in stack or depth > MAX_SUMMARY_DEPTH:
+            return None
+        stack = stack | {(path, id(fn))}
+        facts = self._analyze(path, fn, arg_dts, collect=False,
+                              depth=depth, stack=stack)
+        self._returns[key] = facts.return_dt
+        return facts.return_dt
+
+    # -- the statement walk -------------------------------------------------
+
+    def _analyze(self, path: str, fn: ast.AST,
+                 param_dts: Dict[str, Optional[DT]], collect: bool,
+                 depth: int, stack: Set[Tuple[str, int]]) -> FnFacts:
+        facts = FnFacts()
+        env: Dict[str, Optional[DT]] = {}
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            env[p.arg] = param_dts.get(p.arg)
+        sink = facts if collect else None
+        self._walk_stmts(path, fn.body, env, sink, facts, depth, stack)
+        return facts
+
+    def _walk_stmts(self, path, stmts, env, sink, facts, depth, stack):
+        for stmt in stmts:
+            if isinstance(stmt, _FN_TYPES + (ast.ClassDef,)):
+                continue  # nested scopes get their own facts
+            if isinstance(stmt, ast.Assign):
+                dt = self._eval(path, stmt.value, env, sink, depth, stack)
+                for tgt in stmt.targets:
+                    self._bind(tgt, dt, env)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                dt = self._eval(path, stmt.value, env, sink, depth, stack)
+                self._bind(stmt.target, dt, env)
+            elif isinstance(stmt, ast.AugAssign):
+                cur = self._eval(path, stmt.target, env, sink, depth, stack)
+                dt = promote(cur, self._eval(path, stmt.value, env, sink,
+                                             depth, stack))
+                self._bind(stmt.target, dt, env)
+            elif isinstance(stmt, ast.Return):
+                dt = self._eval(path, stmt.value, env, sink, depth, stack) \
+                    if stmt.value is not None else None
+                facts.return_dt = dt if not facts._returned \
+                    else join(facts.return_dt, dt)
+                facts._returned = True
+            elif isinstance(stmt, ast.For):
+                it = self._eval(path, stmt.iter, env, sink, depth, stack)
+                self._bind(stmt.target, it, env)  # iterating keeps dtype
+                for _ in range(2):  # loop-carried dtypes converge
+                    self._walk_stmts(path, stmt.body, env, sink, facts,
+                                     depth, stack)
+                self._walk_stmts(path, stmt.orelse, env, sink, facts,
+                                 depth, stack)
+            elif isinstance(stmt, ast.While):
+                self._eval(path, stmt.test, env, sink, depth, stack)
+                for _ in range(2):
+                    self._walk_stmts(path, stmt.body, env, sink, facts,
+                                     depth, stack)
+                self._walk_stmts(path, stmt.orelse, env, sink, facts,
+                                 depth, stack)
+            elif isinstance(stmt, ast.If):
+                self._eval(path, stmt.test, env, sink, depth, stack)
+                e1, e2 = dict(env), dict(env)
+                self._walk_stmts(path, stmt.body, e1, sink, facts, depth,
+                                 stack)
+                self._walk_stmts(path, stmt.orelse, e2, sink, facts, depth,
+                                 stack)
+                for k in set(e1) | set(e2):  # branch join
+                    env[k] = join(e1.get(k), e2.get(k)) \
+                        if k in e1 and k in e2 else None
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    dt = self._eval(path, item.context_expr, env, sink,
+                                    depth, stack)
+                    if item.optional_vars is not None:
+                        self._bind(item.optional_vars, dt, env)
+                self._walk_stmts(path, stmt.body, env, sink, facts, depth,
+                                 stack)
+            elif isinstance(stmt, ast.Try):
+                for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                    self._walk_stmts(path, block, env, sink, facts, depth,
+                                     stack)
+                for h in stmt.handlers:
+                    self._walk_stmts(path, h.body, env, sink, facts, depth,
+                                     stack)
+            elif isinstance(stmt, ast.Expr):
+                self._eval(path, stmt.value, env, sink, depth, stack)
+
+    def _bind(self, tgt: ast.expr, dt: Optional[DT], env) -> None:
+        name = dotted_name(tgt)
+        if name is not None:
+            env[name] = dt
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._bind(elt, None, env)
+
+    # -- expression evaluation ----------------------------------------------
+
+    def _eval(self, path: str, expr: ast.expr, env, sink: Optional[FnFacts],
+              depth: int, stack) -> Optional[DT]:
+        if isinstance(expr, ast.Constant):
+            v = expr.value
+            if isinstance(v, bool):
+                return _CONCRETE["bool_"]
+            if isinstance(v, float):
+                return WEAK_FLOAT
+            if isinstance(v, int):
+                return WEAK_INT
+            return None
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            name = dotted_name(expr)
+            if name is not None:
+                root, _, tail = name.rpartition(".")
+                if root in _DTYPE_ROOTS:
+                    return parse_dtype_name(tail)
+                if name in env:
+                    return env[name]  # self.intercept = ... bindings
+            if expr.attr in ("T", "real", "dtype"):
+                return self._eval(path, expr.value, env, sink, depth, stack)
+            return None
+        if isinstance(expr, ast.Subscript):
+            return self._eval(path, expr.value, env, sink, depth, stack)
+        if isinstance(expr, ast.UnaryOp):
+            if isinstance(expr.op, ast.Not):
+                self._eval(path, expr.operand, env, sink, depth, stack)
+                return _CONCRETE["bool_"]
+            return self._eval(path, expr.operand, env, sink, depth, stack)
+        if isinstance(expr, ast.BinOp):
+            left = self._eval(path, expr.left, env, sink, depth, stack)
+            right = self._eval(path, expr.right, env, sink, depth, stack)
+            if isinstance(expr.op, ast.Div) and (
+                    left is not None and left.category != "f"
+                    or right is not None and right.category != "f"):
+                return None  # true division of ints: np f64 / jax f32
+            out = promote(left, right)
+            self._note_promotion(sink, expr, left, right, out)
+            return out
+        if isinstance(expr, ast.Compare):
+            self._eval(path, expr.left, env, sink, depth, stack)
+            for c in expr.comparators:
+                self._eval(path, c, env, sink, depth, stack)
+            return _CONCRETE["bool_"]
+        if isinstance(expr, ast.BoolOp):
+            out: Optional[DT] = None
+            for v in expr.values:
+                out = join(out, self._eval(path, v, env, sink, depth,
+                                           stack)) if out is not None \
+                    else self._eval(path, v, env, sink, depth, stack)
+            return out
+        if isinstance(expr, ast.IfExp):
+            self._eval(path, expr.test, env, sink, depth, stack)
+            return join(self._eval(path, expr.body, env, sink, depth, stack),
+                        self._eval(path, expr.orelse, env, sink, depth,
+                                   stack))
+        if isinstance(expr, ast.Call):
+            return self._eval_call(path, expr, env, sink, depth, stack)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set, ast.Dict,
+                             ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                             ast.DictComp, ast.Lambda)):
+            return None
+        return None
+
+    def _note_promotion(self, sink: Optional[FnFacts], node: ast.AST,
+                        left: Optional[DT], right: Optional[DT],
+                        out: Optional[DT]) -> None:
+        """Record a provably-widening mix of a reduced array with a wide
+        float (the dequant-free violation G017 reports in hot scopes)."""
+        if sink is None or left is None or right is None or out is None:
+            return
+        if not out.wide_float:
+            return
+        reduced = [d for d in (left, right)
+                   if d.reduced_float
+                   or (d.category in ("i", "u") and d.bits <= 8
+                       and not d.weak)]
+        if reduced and any(d.wide_float for d in (left, right)):
+            sink.promotions.append(PromotionSite(node, left, right, out))
+
+    # -- call evaluation ----------------------------------------------------
+
+    def _kwarg(self, call: ast.Call, name: str) -> Optional[ast.expr]:
+        for kw in call.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    def _explicit_dtype(self, path, call, env, positional: Optional[int]
+                        ) -> Tuple[bool, Optional[DT]]:
+        """(given, dtype) for a call that takes dtype= (or a positional)."""
+        kw = self._kwarg(call, "dtype")
+        if kw is not None:
+            return True, self.dtype_of_dtype_expr(path, kw, env)
+        if positional is not None and len(call.args) > positional:
+            dt = self.dtype_of_dtype_expr(path, call.args[positional], env)
+            if dt is not None:
+                return True, dt
+        return False, None
+
+    def _eval_call(self, path, call: ast.Call, env, sink, depth, stack
+                   ) -> Optional[DT]:
+        for arg in call.args:
+            if not isinstance(arg, ast.Starred):
+                self._eval(path, arg, env, sink, depth, stack)
+        for kw in call.keywords:
+            self._eval(path, kw.value, env, sink, depth, stack)
+
+        callee = dotted_name(call.func)
+
+        # x.at[idx].add(u) / .set / .max / .min / .mul / .get
+        if isinstance(call.func, ast.Attribute):
+            at_table = self._at_table(call.func)
+            if at_table is not None:
+                table_dt = self._eval(path, at_table, env, sink, depth,
+                                      stack)
+                if sink is not None and call.func.attr in ("add", "mul"):
+                    sink.scatters.append(ScatterSite(call, call.func.attr,
+                                                     table_dt))
+                return table_dt
+
+        if callee is None:
+            return None
+        root, _, tail = callee.rpartition(".")
+
+        # dtype constructors used as casts: jnp.float32(x), np.int8(x)
+        if root in _DTYPE_ROOTS:
+            dt = parse_dtype_name(tail)
+            if dt is not None:
+                return dt
+
+        if tail == "astype":
+            recv = call.func.value if isinstance(call.func, ast.Attribute) \
+                else None
+            recv_dt = self._eval(path, recv, env, sink, depth, stack) \
+                if recv is not None else None
+            target = None
+            if call.args:
+                target = self.dtype_of_dtype_expr(path, call.args[0], env)
+            else:
+                given, target = self._explicit_dtype(path, call, env, None)
+            if sink is not None and recv is not None:
+                loop = enclosing_loop(call)
+                sink.casts.append(CastSite(
+                    call, recv_dt, target, loop,
+                    self._loop_invariant(recv, loop)))
+            return target
+
+        if tail in ("asarray", "array", "ascontiguousarray"):
+            given, dt = self._explicit_dtype(path, call, env, 1)
+            if given:
+                return dt
+            inner = self._eval(path, call.args[0], env, None, depth, stack) \
+                if call.args else None
+            if inner is not None and inner.weak:
+                if root in _NP_ROOTS:
+                    return _CONCRETE[inner.name]  # np concretizes weak f64
+                if root in _JNP_ROOTS:
+                    return _CONCRETE["float32"] if inner.category == "f" \
+                        else _CONCRETE["int32"]
+                return None
+            return inner
+
+        if tail in ("zeros", "ones", "empty", "full"):
+            pos = 2 if tail == "full" else 1
+            given, dt = self._explicit_dtype(path, call, env, pos)
+            if given:
+                return dt
+            if tail == "full" and len(call.args) > 1:
+                fill = self._eval(path, call.args[1], env, None, depth,
+                                  stack)
+                if fill is None:
+                    return None
+                if root in _NP_ROOTS:
+                    return _CONCRETE[fill.name]
+                if root in _JNP_ROOTS and fill.weak:
+                    return _CONCRETE["float32"] if fill.category == "f" \
+                        else _CONCRETE["int32"]
+                return DT(fill.name, fill.category, fill.bits)
+            if root in _NP_ROOTS:
+                return _CONCRETE["float64"]
+            if root in _JNP_ROOTS:
+                return _CONCRETE["float32"]
+            return None
+
+        if tail in ("zeros_like", "ones_like", "empty_like", "full_like"):
+            given, dt = self._explicit_dtype(path, call, env, None)
+            if given:
+                return dt
+            return self._eval(path, call.args[0], env, None, depth, stack) \
+                if call.args else None
+
+        if tail == "linspace":
+            given, dt = self._explicit_dtype(path, call, env, None)
+            if given:
+                return dt
+            if root in _NP_ROOTS:
+                return _CONCRETE["float64"]
+            if root in _JNP_ROOTS:
+                return _CONCRETE["float32"]
+            return None
+
+        if tail in ("float", "int") and root == "":
+            return WEAK_FLOAT if tail == "float" else WEAK_INT
+
+        if tail in REDUCTION_TAILS:
+            operand = None
+            if isinstance(call.func, ast.Attribute) and root not in \
+                    _NP_ROOTS + _JNP_ROOTS + ("jax.ops", "jax.lax", "lax"):
+                operand = self._eval(path, call.func.value, env, sink,
+                                     depth, stack)  # x.sum()
+            elif call.args:
+                # args were already evaluated (events recorded) above —
+                # re-evaluate without the sink to avoid duplicates
+                operand = self._eval(path, call.args[0], env, None, depth,
+                                     stack)
+            given, acc_dt = self._explicit_dtype(path, call, env, None)
+            # an explicit dtype= that does not RESOLVE (a threaded
+            # parameter) is trusted like every unknown — only an explicit
+            # accumulator provably equal to a reduced operand stays
+            # flaggable
+            widened = given and (
+                acc_dt is None or operand is None
+                or acc_dt.bits > operand.bits
+                or acc_dt.category != operand.category)
+            if sink is not None:
+                sink.reductions.append(ReductionSite(call, tail, operand,
+                                                     widened))
+            return acc_dt if given else operand
+
+        if tail in _PRESERVING_METHODS and isinstance(call.func,
+                                                      ast.Attribute):
+            return self._eval(path, call.func.value, env, sink, depth,
+                              stack)
+
+        if tail in _ELEMENTWISE_CALLS and root in _NP_ROOTS + _JNP_ROOTS:
+            args = [a for a in call.args
+                    if not isinstance(a, ast.Starred)]
+            if tail == "where":
+                args = args[1:]
+            dts = [self._eval(path, a, env, None, depth, stack)
+                   for a in args]
+            out: Optional[DT] = dts[0] if dts else None
+            for d in dts[1:]:
+                out = promote(out, d)
+            if tail in _BINARY_PROMOTING_CALLS and len(dts) >= 2:
+                self._note_promotion(sink, call, dts[0], dts[1], out)
+            return out
+
+        # calls to resolvable defs: bind argument dtypes, join return exprs
+        if "." not in callee:
+            got = self.program.resolve_fn(path, callee, call)
+            if got is not None:
+                t_path, t_fn = got
+                arg_dts = self._arg_dtypes(path, call, t_fn, env, depth,
+                                           stack)
+                return self._return_dtype(t_path, t_fn, arg_dts, depth + 1,
+                                          stack)
+        return None
+
+    def _arg_dtypes(self, path, call, callee_fn, env, depth, stack
+                    ) -> Dict[str, Optional[DT]]:
+        a = callee_fn.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        offset = 1 if params[:1] == ["self"] else 0
+        out: Dict[str, Optional[DT]] = {}
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            j = i + offset
+            if j < len(params):
+                out[params[j]] = self._eval(path, arg, env, None, depth,
+                                            stack)
+        for kw in call.keywords:
+            if kw.arg is not None:
+                out[kw.arg] = self._eval(path, kw.value, env, None, depth,
+                                         stack)
+        return out
+
+    # -- helpers -------------------------------------------------------------
+
+    @staticmethod
+    def _at_table(func: ast.Attribute) -> Optional[ast.expr]:
+        """table expr of a ``table.at[...].method`` chain, else None."""
+        if func.attr not in ("add", "set", "max", "min", "mul", "get",
+                             "multiply"):
+            return None
+        sub = func.value
+        if isinstance(sub, ast.Subscript) \
+                and isinstance(sub.value, ast.Attribute) \
+                and sub.value.attr == "at":
+            return sub.value.value
+        return None
+
+    @staticmethod
+    def _loop_invariant(recv: ast.expr, loop: Optional[ast.AST]) -> bool:
+        """True when the astype receiver is a Name that no statement inside
+        the enclosing loop rebinds — the cast re-materializes the same
+        array every iteration."""
+        if loop is None or not isinstance(recv, ast.Name):
+            return False
+        name = recv.id
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if any(isinstance(n, ast.Name) and n.id == name
+                           for n in ast.walk(tgt)):
+                        return False
+            elif isinstance(node, ast.AugAssign):
+                if any(isinstance(n, ast.Name) and n.id == name
+                       for n in ast.walk(node.target)):
+                    return False
+            elif isinstance(node, ast.For):
+                if any(isinstance(n, ast.Name) and n.id == name
+                       for n in ast.walk(node.target)):
+                    return False
+        return True
+
+
+def get_model(program: ProgramModel) -> DtypeFlow:
+    """One DtypeFlow per ProgramModel (all five dtype rules share it)."""
+    model = getattr(program, "_graftcheck_dtypeflow", None)
+    if model is None:
+        model = DtypeFlow(program)
+        program._graftcheck_dtypeflow = model
+    return model
+
+
+def in_hot_scope(path: str, model: Optional[ModuleModel],
+                 fn: Optional[ast.AST] = None) -> bool:
+    """Hot-path scoping for G017/G019: the kernel/op packages and the
+    serving score path always; elsewhere in the dtype-sensitive packages
+    only traced or step-shaped functions (their math runs per step)."""
+    from . import config
+
+    if path.startswith(config.DTYPEFLOW_HOT_PREFIXES) \
+            or path in config.DTYPEFLOW_HOT_MODULES:
+        return True
+    if model is not None and config.HOT_MARKER in model.source:
+        return True
+    if fn is not None and model is not None \
+            and path.startswith(config.DTYPE_MODULE_PREFIXES):
+        if model.is_traced(fn) or config.HOT_FN_RE.match(
+                getattr(fn, "name", "")):
+            return True
+    return False

@@ -15,15 +15,19 @@ from . import (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
                g008_spec_mesh, g009_api_compat, g010_unreduced_output,
                g011_divergent_collective, g012_unguarded_shared_field,
                g013_blocking_under_lock, g014_cv_misuse, g015_thread_leak,
-               g016_lock_order_cycle)
+               g016_lock_order_cycle, g017_hot_promotion, g018_f64_leak,
+               g019_cast_in_loop, g020_artifact_dtype,
+               g021_low_precision_accum)
 
 _MODULE_RULES = (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
                  g005_donation, g006_side_effect, g009_api_compat,
-                 g015_thread_leak)
+                 g015_thread_leak, g018_f64_leak)
 _PROGRAM_RULES = (g007_collective_axis, g008_spec_mesh,
                   g010_unreduced_output, g011_divergent_collective,
                   g012_unguarded_shared_field, g013_blocking_under_lock,
-                  g014_cv_misuse, g016_lock_order_cycle)
+                  g014_cv_misuse, g016_lock_order_cycle,
+                  g017_hot_promotion, g019_cast_in_loop,
+                  g020_artifact_dtype, g021_low_precision_accum)
 
 ALL_RULES: Dict[str, Callable[[ModuleModel], List[Finding]]] = {
     m.RULE_ID: m.check for m in _MODULE_RULES

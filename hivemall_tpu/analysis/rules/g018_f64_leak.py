@@ -1,0 +1,130 @@
+"""G018 weak-scalar/float64-leak: f64 defaults entering the serving path.
+
+The serving tables are f32 (bf16 in the quantized manifests); a request
+payload or intermediate staged as float64 doubles host staging bandwidth
+and — when it reaches a device array — HBM traffic, for zero precision
+the score math ever uses. Three provable channels, scoped to the
+serving/IO modules (``serving/``, ``io/``, plus ``# graftcheck:
+serving-module`` opt-ins):
+
+- an explicit ``np.float64`` / ``np.double`` dtype (the
+  ``serving/engine.py`` request-payload/intercept hits this rule was
+  dogfooded on) — machine-fixable: ``--fix`` rewrites the token to
+  ``np.float32``, matching the table dtype;
+- ``astype(float)`` / ``dtype=float`` — Python's ``float`` IS float64;
+- a float64-*by-default* numpy constructor: ``np.zeros/ones/empty``
+  without a dtype (and ``np.full`` with a float fill) — the "weak Python
+  scalar becomes a wide array" channel; single-line sites carry a fix
+  appending ``dtype=np.float32``.
+
+``jnp.*`` constructors default to f32 and are never flagged;
+``np.asarray`` without a dtype follows its input and is trusted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import config
+from ..findings import Edit, Finding, Fix, Severity
+from ..modmodel import ModuleModel, dotted_name
+
+RULE_ID = "G018"
+
+_F64_NAMES = ("np.float64", "numpy.float64", "np.double", "numpy.double",
+              "np.float_", "numpy.float_")
+_DEFAULT_F64_CTORS = ("zeros", "ones", "empty")
+
+
+def _in_scope(model: ModuleModel) -> bool:
+    return (model.rel_path.startswith(config.DTYPEFLOW_SERVING_PREFIXES)
+            or config.CONCURRENCY_MARKER in model.source)
+
+
+def _token_fix(model: ModuleModel, lineno: int, old: str, new: str
+               ) -> Optional[Fix]:
+    line = model.lines[lineno - 1] if 1 <= lineno <= len(model.lines) else ""
+    if old in line:
+        return Fix(edits=(Edit(lineno, old, new),))
+    return None
+
+
+def _pin_dtype_fix(model: ModuleModel, call: ast.Call) -> Optional[Fix]:
+    """Append ``dtype=np.float32`` to a single-line constructor call."""
+    if (call.end_lineno or call.lineno) != call.lineno:
+        return None
+    line = model.lines[call.lineno - 1] if call.lineno <= len(model.lines) \
+        else ""
+    seg = line[call.col_offset:call.end_col_offset]
+    if not seg.endswith(")") or line.count(seg) != 1:
+        return None
+    return Fix(edits=(Edit(call.lineno, seg,
+                           seg[:-1] + ", dtype=np.float32)"),))
+
+
+def _has_dtype(call: ast.Call, positional: int) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    if any(isinstance(a, ast.Starred) for a in call.args) \
+            or any(kw.arg is None for kw in call.keywords):
+        return True  # *args / **kwargs may carry the dtype: trusted
+    return len(call.args) > positional
+
+
+def _float_fill(node: ast.expr) -> bool:
+    if isinstance(node, ast.UnaryOp):
+        node = node.operand
+    return isinstance(node, ast.Constant) and isinstance(node.value, float)
+
+
+def check(model: ModuleModel) -> List[Finding]:
+    if not _in_scope(model):
+        return []
+    findings: List[Finding] = []
+
+    def emit(node: ast.AST, msg: str, fix: Optional[Fix]) -> None:
+        findings.append(Finding(model.rel_path, node.lineno, RULE_ID,
+                                Severity.ERROR, msg,
+                                model.snippet(node.lineno), fix=fix))
+
+    for node in ast.walk(model.tree):
+        name = dotted_name(node) if isinstance(node, (ast.Attribute,
+                                                      ast.Name)) else None
+        if name in _F64_NAMES:
+            parent = getattr(node, "graftcheck_parent", None)
+            if isinstance(parent, ast.Attribute):
+                continue  # attribute inside a longer dotted chain
+            fix = _token_fix(model, node.lineno, "np.float64",
+                             "np.float32") if name == "np.float64" else None
+            emit(node, f"{name} on the serving path — request payloads and "
+                       f"intermediates should match the f32 table dtype "
+                       f"(f64 doubles host and HBM bandwidth for precision "
+                       f"the score math never uses); use np.float32", fix)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func) or ""
+        root, _, tail = callee.rpartition(".")
+        if tail == "astype" and node.args:
+            a = node.args[0]
+            if isinstance(a, ast.Name) and a.id == "float":
+                emit(node, "astype(float) is float64 on the serving path — "
+                           "pin np.float32 (the table dtype)", None)
+        elif any(kw.arg == "dtype" and isinstance(kw.value, ast.Name)
+                 and kw.value.id == "float" for kw in node.keywords):
+            emit(node, "dtype=float is float64 on the serving path — pin "
+                       "np.float32 (the table dtype)", None)
+        elif root in ("np", "numpy") and tail in _DEFAULT_F64_CTORS:
+            if not _has_dtype(node, 1):
+                emit(node, f"np.{tail} without a dtype allocates float64 — "
+                           f"the weak-scalar default leak; pin "
+                           f"dtype=np.float32 to match the serving tables",
+                     _pin_dtype_fix(model, node))
+        elif root in ("np", "numpy") and tail == "full":
+            if not _has_dtype(node, 2) and len(node.args) > 1 \
+                    and _float_fill(node.args[1]):
+                emit(node, "np.full with a Python-float fill allocates "
+                           "float64 — pin dtype=np.float32 to match the "
+                           "serving tables", _pin_dtype_fix(model, node))
+    return findings

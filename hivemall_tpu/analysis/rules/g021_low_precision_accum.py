@@ -1,0 +1,81 @@
+"""G021 accumulate-in-low-precision: reductions whose accumulator is <32-bit.
+
+The one place widening is *required*: a ``sum``/``mean``/``cumsum``/
+``segment_sum`` or a ``.at[...].add`` scatter whose accumulator dtype
+equals a bf16/f16 input. Reduced floats carry 8-11 mantissa bits — a
+16k-element bf16 sum has absorbed-update error on the order of the values
+themselves, and an online-learning scatter-add that accumulates bf16
+*loses* small gradient contributions entirely (the reference shipped its
+half-float codec for storage, never for accumulation). The dtype-flow
+model proves the operand/table dtype; the fix is an explicit widened
+accumulator (``dtype=jnp.float32`` on the reduction, or accumulate f32
+and cast once at the table write — the models/base.py storage policy).
+
+Scoped to the dtype-sensitive packages plus the hot-path scopes; unknown
+dtypes (parameters, dynamic tables) are trusted, and a reduction that
+already passes a wider ``dtype=`` is the sanctioned idiom.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from .. import config
+from ..dtypeflow import get_model, in_hot_scope
+from ..findings import Finding, Severity
+from ..program import ProgramModel
+
+RULE_ID = "G021"
+
+
+def _module_in_scope(path: str, source: str) -> bool:
+    return (path.startswith(config.DTYPE_MODULE_PREFIXES
+                            + config.DTYPEFLOW_HOT_PREFIXES)
+            or path in config.DTYPEFLOW_HOT_MODULES
+            or "# graftcheck: dtype-module" in source
+            or config.HOT_MARKER in source)
+
+
+def check_program(program: ProgramModel, scanned: Set[str]
+                  ) -> List[Finding]:
+    findings: List[Finding] = []
+    flow = get_model(program)
+    for path in sorted(scanned):
+        model = program.modules.get(path)
+        if model is None:
+            continue
+        if not (_module_in_scope(path, model.source)
+                or any(in_hot_scope(path, model, fn)
+                       for fn in model.functions)):
+            continue
+        seen: Set[int] = set()
+        for fn in model.functions:
+            facts = flow.facts(path, fn)
+            for red in facts.reductions:
+                if red.widened or red.operand_dt is None \
+                        or not red.operand_dt.reduced_float \
+                        or red.node.lineno in seen:
+                    continue
+                seen.add(red.node.lineno)
+                findings.append(Finding(
+                    path, red.node.lineno, RULE_ID, Severity.ERROR,
+                    f"{red.tail} over a {red.operand_dt.name} operand "
+                    f"accumulates in {red.operand_dt.name} — 8-11 mantissa "
+                    f"bits absorb small contributions entirely; widen the "
+                    f"accumulator (dtype=jnp.float32) and cast once at the "
+                    f"result write",
+                    model.snippet(red.node.lineno)))
+            for sc in facts.scatters:
+                if sc.table_dt is None or not sc.table_dt.reduced_float \
+                        or sc.node.lineno in seen:
+                    continue
+                seen.add(sc.node.lineno)
+                findings.append(Finding(
+                    path, sc.node.lineno, RULE_ID, Severity.ERROR,
+                    f".at[].{sc.method} into a {sc.table_dt.name} table "
+                    f"accumulates updates in {sc.table_dt.name} — online "
+                    f"updates smaller than ~1/256 of the weight vanish; "
+                    f"accumulate f32 and cast once at the table write "
+                    f"(the models/base.py storage policy)",
+                    model.snippet(sc.node.lineno)))
+    return findings

@@ -91,15 +91,41 @@ def dense_from_rows(dims: int, feats: np.ndarray, weights: np.ndarray,
     return w, c
 
 
+def np_saveable(x: np.ndarray) -> np.ndarray:
+    """npz-stable host array: bf16 (which np.savez cannot round-trip
+    reliably) widens to f32 — value-exact; the recorded ``weights_dtype``
+    entry narrows it back at load (the graftcheck G020 contract). The
+    widen half of the at-rest protocol, shared with serving/artifact."""
+    a = np.asarray(x)
+    if a.dtype.name == "bfloat16":
+        return a.astype(np.float32)
+    return a
+
+
+def dtype_from_name(name):
+    """The narrow half of the at-rest protocol: a recorded dtype NAME back
+    to the dtype device tables must reload at. bf16 needs the ml_dtypes
+    object (the string means nothing to jnp.asarray); every other name —
+    or None for pre-protocol checkpoints — passes through as-is."""
+    if name == "bfloat16":
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+    return name
+
+
 def save_linear_state(path: str, state: LinearState) -> None:
     host = jax.device_get(state)
     arrays = {
-        "weights": np.asarray(host.weights),
+        "weights": np_saveable(host.weights),
         "touched": np.asarray(host.touched),
         "step": np.asarray(host.step),
+        # the dtype the state TRAINED with — resume must re-narrow a bf16
+        # table rather than silently continue in f32
+        "weights_dtype": np.asarray(np.asarray(host.weights).dtype.name),
     }
     if host.covars is not None:
-        arrays["covars"] = np.asarray(host.covars)
+        arrays["covars"] = np_saveable(host.covars)
     for k, v in host.slots.items():
         arrays[f"slot__{k}"] = np.asarray(v)
     for k, v in host.globals.items():
@@ -113,15 +139,22 @@ def load_linear_state(path: str) -> LinearState:
     # all arrays materialize inside the with: NpzFile reads lazily from the
     # underlying zip and must be closed (fd leak otherwise)
     with np.load(path) as z:
-        slots = {k[len("slot__"):]: jnp.asarray(z[k]) for k in z.files
-                 if k.startswith("slot__")}
-        globals_ = {k[len("global__"):]: jnp.asarray(z[k]) for k in z.files
-                    if k.startswith("global__")}
+        # dtype pins (graftcheck G020): weights/covars re-narrow to their
+        # recorded training dtype; slots/globals/touched/step are f32 /
+        # int8 / int32 by construction (core/state.init_linear_state)
+        wdt = str(z["weights_dtype"][()]) if "weights_dtype" in z.files \
+            else None
+        table_dt = dtype_from_name(wdt)
+        slots = {k[len("slot__"):]: jnp.asarray(z[k], jnp.float32)
+                 for k in z.files if k.startswith("slot__")}
+        globals_ = {k[len("global__"):]: jnp.asarray(z[k], jnp.float32)
+                    for k in z.files if k.startswith("global__")}
         return LinearState(
-            weights=jnp.asarray(z["weights"]),
-            covars=jnp.asarray(z["covars"]) if "covars" in z.files else None,
+            weights=jnp.asarray(z["weights"], table_dt),
+            covars=jnp.asarray(z["covars"], table_dt)
+            if "covars" in z.files else None,
             slots=slots,
-            touched=jnp.asarray(z["touched"]),
-            step=jnp.asarray(z["step"]),
+            touched=jnp.asarray(z["touched"], jnp.int8),
+            step=jnp.asarray(z["step"], jnp.int32),
             globals=globals_,
         )

@@ -47,13 +47,25 @@ ARRAYS_FILE = "arrays.npz"
 
 def _host(x) -> np.ndarray:
     """Device array -> host numpy, bf16 widened to f32 (np.savez cannot
-    round-trip ml_dtypes reliably; the widening is value-exact)."""
+    round-trip ml_dtypes reliably; the widening is value-exact — the
+    io/checkpoint at-rest protocol)."""
     import jax
 
-    a = np.asarray(jax.device_get(x))
-    if a.dtype.name == "bfloat16":
-        return a.astype(np.float32)
-    return a
+    from ..io.checkpoint import np_saveable
+
+    return np_saveable(jax.device_get(x))
+
+
+def manifest_dtype(meta: dict, default: str = "float32"):
+    """The dtype a family's device tables must reload at — the dtype the
+    model TRAINED with (``meta["weights_dtype"]``, recorded at freeze),
+    not whatever width the widened-at-rest pack holds. This is the load
+    half of the widen-at-rest / narrow-at-serve contract graftcheck G020
+    enforces: ``jnp.asarray(pack[...])`` without this pin resurrects a
+    bf16 table as f32 and silently doubles serving HBM traffic."""
+    from ..io.checkpoint import dtype_from_name
+
+    return dtype_from_name(meta.get("weights_dtype", default))
 
 
 def family_of(model) -> str:
@@ -137,7 +149,9 @@ def _unpack_trees(prefix: str, n: int, arrays: dict):
 def _pack_bins(bins, arrays: dict, meta: dict) -> None:
     meta["bins_nominal"] = [bool(b.nominal) for b in bins]
     for f, b in enumerate(bins):
-        arrays[f"bin{f}__edges"] = np.asarray(b.edges, np.float64)
+        # widen-at-rest: the pack keeps the training-precision edges; the
+        # serving engine narrows to f32 at load (_TreeServable)
+        arrays[f"bin{f}__edges"] = np.asarray(b.edges, np.float64)  # graftcheck: disable=G018 (at-rest precision; serving narrows at load)
 
 
 def _unpack_bins(meta: dict, arrays: dict):
@@ -173,7 +187,7 @@ def _build_payload(model):
             arrays["covar"] = _host(rows[2])
         meta.update(dims=int(model.dims), rule=model.rule.name,
                     use_covariance=bool(model.rule.use_covariance),
-                    weights_dtype=np.asarray(model.state.weights).dtype.name)
+                    weights_dtype=np.dtype(model.state.weights.dtype).name)
     elif family == "multiclass":
         st = model.state
         arrays["weights"] = _host(st.weights)
@@ -181,7 +195,8 @@ def _build_payload(model):
             arrays["covars"] = _host(st.covars)
         meta.update(dims=int(model.dims),
                     label_vocab=_vocab_jsonable(model.label_vocab),
-                    use_covariance=st.covars is not None)
+                    use_covariance=st.covars is not None,
+                    weights_dtype=np.dtype(st.weights.dtype).name)
     elif family == "fm":
         st, hy = model.state, model.hyper
         for k in ("w0", "w", "v", "lambda_w0", "lambda_w", "lambda_v"):
@@ -190,7 +205,8 @@ def _build_payload(model):
         meta.update(dims=int(model.dims), factors=int(hy.factors),
                     classification=bool(hy.classification),
                     sigma=float(hy.sigma), seed=int(hy.seed),
-                    lambda0=float(hy.lambda0))
+                    lambda0=float(hy.lambda0),
+                    weights_dtype=np.dtype(st.w.dtype).name)
     elif family == "ffm":
         # the utils/codec compressed-blob recipe (FFMPredictionModel
         # writeExternal analog); half_float=False keeps bit-exactness
@@ -207,7 +223,8 @@ def _build_payload(model):
         meta.update(use_bias=bool(model.use_bias),
                     num_users=int(arrays["P"].shape[0]),
                     num_items=int(arrays["Q"].shape[0]),
-                    factor=int(arrays["P"].shape[1]))
+                    factor=int(arrays["P"].shape[1]),
+                    weights_dtype=np.dtype(st.P.dtype).name)
     elif family == "forest":
         _pack_trees("tree", [t.tree for t in model.trees], arrays)
         _pack_bins(model.bins, arrays, meta)
@@ -219,7 +236,7 @@ def _build_payload(model):
         flat = [t for round_trees in model.trees for t in round_trees]
         _pack_trees("tree", flat, arrays)
         _pack_bins(model.bins, arrays, meta)
-        arrays["intercept"] = np.asarray(model.intercept, np.float64)
+        arrays["intercept"] = np.asarray(model.intercept, np.float64)  # graftcheck: disable=G018 (at-rest training dtype; serving narrows at load)
         arrays["classes"] = np.asarray(model.classes)
         meta.update(n_rounds=len(model.trees),
                     n_class_trees=len(model.trees[0]) if model.trees else 0,
@@ -317,10 +334,11 @@ def rebuild_model(artifact: Artifact):
         from ..models.mf import MFState, TrainedMFModel
 
         n_u, n_i = int(meta["num_users"]), int(meta["num_items"])
+        dt = manifest_dtype(meta)  # reload at the TRAINED dtype (G020)
         st = MFState(
-            P=jnp.asarray(a["P"]), Q=jnp.asarray(a["Q"]),
-            Bu=jnp.asarray(a["Bu"]), Bi=jnp.asarray(a["Bi"]),
-            mu=jnp.asarray(a["mu"]), P_gg=None, Q_gg=None,
+            P=jnp.asarray(a["P"], dt), Q=jnp.asarray(a["Q"], dt),
+            Bu=jnp.asarray(a["Bu"], dt), Bi=jnp.asarray(a["Bi"], dt),
+            mu=jnp.asarray(a["mu"], dt), P_gg=None, Q_gg=None,
             touched_u=jnp.ones((n_u,), jnp.int8),
             touched_i=jnp.ones((n_i,), jnp.int8),
             step=jnp.zeros((), jnp.int32))

@@ -42,7 +42,8 @@ import numpy as np
 from ..core.batch import pack_rows, pad_to_bucket
 from ..runtime.metrics import REGISTRY, recompile_guard
 from ..runtime.tracing import TRACER
-from .artifact import Artifact, family_of, load, rebuild_model
+from .artifact import Artifact, family_of, load, manifest_dtype, \
+    rebuild_model
 
 # serving latency is sub-ms-to-seconds shaped; finer low end than the
 # metrics default
@@ -50,10 +51,11 @@ LATENCY_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
                    0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
 
 
-def _bf16_or(name: str):
-    import jax.numpy as jnp
-
-    return jnp.bfloat16 if name == "bfloat16" else name
+# The serving dtype contract (graftcheck G017-G021, docs/static_analysis.md
+# "preparing for quantized artifacts"): request payloads and host staging are
+# f32, device tables reload at their MANIFEST dtype (artifact.manifest_dtype)
+# — never at whatever width the widened-at-rest pack happens to hold — and
+# nothing on the score path allocates f64.
 
 
 class _Servable:
@@ -118,8 +120,8 @@ class _SparseRowServable(_Servable):
         idx_rows, val_rows = _stage_rows(instances, self.dims)
         n = len(idx_rows)
         width = min(pad_to_bucket(self.max_nnz(idx_rows)), width_cap)
-        return pack_rows(idx_rows, val_rows, np.zeros(n), self.dims,
-                         width=width, batch_size=b_pad)
+        return pack_rows(idx_rows, val_rows, np.zeros(n, dtype=np.float32),
+                         self.dims, width=width, batch_size=b_pad)
 
     def dummy_instance(self, width):
         return [(i, 1.0) for i in range(width)]
@@ -246,9 +248,28 @@ class _TreeServable(_Servable):
     has_width = False
 
     def __init__(self, trees_flat, bins) -> None:
+        from ..models.trees.binning import BinInfo
         from ..models.trees.grow import predict_forest_binned, stack_trees
 
-        self.bins = bins
+        # f32 request staging with edges narrowed ALONGSIDE: an edge that IS
+        # a data value stays equal to it (both sides of the searchsorted
+        # round identically), so every training-valued instance bins as the
+        # tree was grown. Request values within one f32 ulp of an edge may
+        # bin to the neighbor — the f32-resolution quantization the serving
+        # dtype contract accepts (request payloads stage f32, G018). NOT
+        # acceptable is distinct edges that collapse under f32 — nominal
+        # category codes >= 2^24, or quantile edges of large-magnitude
+        # quantitative features (timestamps ~1.7e9 have f32 spacing of 128)
+        # — where a duplicated edge makes a bin entirely unreachable: any
+        # collapsing bin keeps the model on the f64 path end to end.
+        if any(np.unique(np.asarray(b.edges, np.float32)).size
+               != len(b.edges) for b in bins):
+            self.stage_dtype = np.float64  # graftcheck: disable=G018 (distinct bin edges collapse under f32; binning parity needs f64)
+            self.bins = bins
+        else:
+            self.stage_dtype = np.float32
+            self.bins = [BinInfo(b.nominal, np.asarray(b.edges, np.float32),
+                                 b.n_bins) for b in bins]
         self.n_features = len(bins)
         self.stacked = stack_trees(trees_flat) if trees_flat else None
         self._walk = predict_forest_binned
@@ -257,15 +278,15 @@ class _TreeServable(_Servable):
     def stage(self, instances, b_pad, width_cap):
         from ..models.trees.binning import bin_data
 
-        X = np.asarray(instances, np.float64).reshape(len(instances),
-                                                      self.n_features)
+        X = np.asarray(instances, self.stage_dtype).reshape(
+            len(instances), self.n_features)
         Xb = np.zeros((b_pad, self.n_features), np.int32)
         Xb[:len(instances)] = bin_data(X, self.bins)
         return Xb
 
     def dispatch(self, staged):
         if self.stacked is None:
-            return np.zeros((0, staged.shape[0]))
+            return np.zeros((0, staged.shape[0]), dtype=np.float32)
         return self._walk(self.stacked, staged)
 
     def dummy_instance(self, width):
@@ -298,7 +319,9 @@ class _GBTServable(_TreeServable):
         super().__init__(trees_flat, bins)
         self.n_rounds = n_rounds
         self.K = n_class_trees
-        self.intercept = np.asarray(intercept, np.float64)
+        # staged at the tree path's dtype: f32 normally, f64 when the
+        # collapse guard kept the model on the f64 path end to end
+        self.intercept = np.asarray(intercept, self.stage_dtype)
         self.shrinkage = float(shrinkage)
         self.classes = np.asarray(classes)
 
@@ -318,6 +341,10 @@ def _servable_from_artifact(art: Artifact) -> _Servable:
 
     meta = art.meta
     a = art.arrays
+    # every device table reloads at its MANIFEST dtype: the pack stores
+    # reduced tables widened (value-exact), so asarray without a pin would
+    # silently serve a bf16-trained model at 2x HBM traffic (G020)
+    table_dt = manifest_dtype(meta)
     if art.family == "linear":
         from ..core.state import init_linear_state
         from ..io.checkpoint import dense_from_rows
@@ -326,16 +353,16 @@ def _servable_from_artifact(art: Artifact) -> _Servable:
                                a.get("covar"))
         state = init_linear_state(
             int(meta["dims"]), use_covariance=bool(meta["use_covariance"]),
-            dtype=_bf16_or(meta.get("weights_dtype", "float32")),
-            initial_weights=w, initial_covars=c)
+            dtype=table_dt, initial_weights=w, initial_covars=c)
         return _LinearServable(state, int(meta["dims"]))
     if art.family == "multiclass":
         from ..models.multiclass import MulticlassState
 
-        weights = jnp.asarray(a["weights"])
+        weights = jnp.asarray(a["weights"], table_dt)
         state = MulticlassState(
             weights=weights,
-            covars=jnp.asarray(a["covars"]) if "covars" in a else None,
+            covars=jnp.asarray(a["covars"], table_dt) if "covars" in a
+            else None,
             touched=jnp.ones(weights.shape, jnp.int8),
             step=jnp.zeros((), jnp.int32))
         return _MulticlassServable(state, meta["label_vocab"],
@@ -344,11 +371,13 @@ def _servable_from_artifact(art: Artifact) -> _Servable:
         from ..models.fm import FMState
 
         state = FMState(
-            w0=jnp.asarray(a["w0"]), w=jnp.asarray(a["w"]),
-            v=jnp.asarray(a["v"]), lambda_w0=jnp.asarray(a["lambda_w0"]),
-            lambda_w=jnp.asarray(a["lambda_w"]),
-            lambda_v=jnp.asarray(a["lambda_v"]),
-            touched=jnp.asarray(a["touched"]),
+            w0=jnp.asarray(a["w0"], table_dt),
+            w=jnp.asarray(a["w"], table_dt),
+            v=jnp.asarray(a["v"], table_dt),
+            lambda_w0=jnp.asarray(a["lambda_w0"], table_dt),
+            lambda_w=jnp.asarray(a["lambda_w"], table_dt),
+            lambda_v=jnp.asarray(a["lambda_v"], table_dt),
+            touched=jnp.asarray(a["touched"], jnp.int8),
             step=jnp.zeros((), jnp.int32))
         return _FMServable(state, int(meta["dims"]))
     if art.family == "ffm":

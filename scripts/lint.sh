@@ -32,10 +32,29 @@ while [[ $# -gt 0 ]]; do
   esac
 done
 
+# every gating scan also archives its findings as SARIF (analysis.sarif) so
+# CI annotation upload is one flag away (codeql-action/upload-sarif); stdout
+# keeps the text summary. --fix-check mode plans fixes instead of reporting,
+# so the artifact flags are omitted there.
+sarif_args=(--format sarif --output analysis.sarif)
+if [[ ${#mode_args[@]} -gt 0 ]]; then
+  sarif_args=()
+fi
+# fix/baseline flags forwarded after a file list (lint.sh f.py --fix,
+# lint.sh f.py --update-baseline) also make the run a non-report one —
+# the analyzer rejects --output there as a usage error
+for arg in "$@"; do
+  case "$arg" in
+    --fix|--fix-check|--update-baseline) sarif_args=() ;;
+  esac
+done
+
 if [[ $all -eq 1 ]]; then
-  exec python -m hivemall_tpu.analysis hivemall_tpu/ ${mode_args[@]+"${mode_args[@]}"}
+  exec python -m hivemall_tpu.analysis hivemall_tpu/ \
+    ${sarif_args[@]+"${sarif_args[@]}"} ${mode_args[@]+"${mode_args[@]}"}
 elif [[ $# -gt 0 ]]; then
-  exec python -m hivemall_tpu.analysis "$@" ${mode_args[@]+"${mode_args[@]}"}
+  exec python -m hivemall_tpu.analysis "$@" \
+    ${sarif_args[@]+"${sarif_args[@]}"} ${mode_args[@]+"${mode_args[@]}"}
 fi
 
 # changed-files mode needs git; outside a work tree (tarball checkouts, CI
@@ -43,7 +62,8 @@ fi
 # checking nothing
 if ! git rev-parse --is-inside-work-tree >/dev/null 2>&1; then
   echo "graftcheck: git diff unavailable — falling back to full-tree scan"
-  exec python -m hivemall_tpu.analysis hivemall_tpu/ ${mode_args[@]+"${mode_args[@]}"}
+  exec python -m hivemall_tpu.analysis hivemall_tpu/ \
+    ${sarif_args[@]+"${sarif_args[@]}"} ${mode_args[@]+"${mode_args[@]}"}
 fi
 
 # python files under hivemall_tpu/ touched since HEAD
@@ -66,4 +86,4 @@ fi
 # --with-callers widens the scan to modules importing the changed ones, so
 # interprocedural findings surfacing in unchanged callers are still caught
 exec python -m hivemall_tpu.analysis --with-callers "${existing[@]}" \
-  ${mode_args[@]+"${mode_args[@]}"}
+  ${sarif_args[@]+"${sarif_args[@]}"} ${mode_args[@]+"${mode_args[@]}"}

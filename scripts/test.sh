@@ -9,9 +9,14 @@ cd "$(dirname "$0")/.."
 
 # tier-1 gate 1: graftcheck static analysis on changed files (+ their
 # callers) — any new non-baselined recompile/host-sync/dtype/axis/donation/
-# side-effect/SPMD-safety finding fails before pytest spends minutes
-# (docs/static_analysis.md)
+# side-effect/SPMD-safety/precision-flow finding fails before pytest spends
+# minutes (docs/static_analysis.md)
 bash scripts/lint.sh
+# the gate also archived its findings as SARIF; keep the path stable so CI
+# can upload it as an annotation artifact (codeql-action/upload-sarif)
+if [[ -f analysis.sarif ]]; then
+  echo "graftcheck: SARIF artifact kept at analysis.sarif"
+fi
 
 # tier-1 gate 2: no machine-applicable fix may be left unapplied in the
 # changed files — if `--fix` would produce a diff there, fail with the

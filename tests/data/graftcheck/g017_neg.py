@@ -1,0 +1,32 @@
+"""Known-negative G017 cases: same-width math, weak scalars, explicit
+casts, and unknown operands are all trusted.
+
+# graftcheck: hot-module
+"""
+import jax.numpy as jnp
+
+
+def reduced_stays_reduced():
+    table = jnp.zeros((64,), jnp.bfloat16)
+    scale = jnp.ones((64,), jnp.bfloat16)
+    return table * scale  # bf16 x bf16: no widening
+
+
+def weak_scalar_follows_array():
+    table = jnp.zeros((64,), jnp.bfloat16)
+    return table * 2.0  # Python scalar promotes BY the array (weak)
+
+
+def unknown_operand_is_trusted(table):
+    return table * jnp.ones((64,), jnp.float32)  # param dtype unknown
+
+
+def explicit_widening(table):
+    wide = table.astype(jnp.float32)  # declared: not a SILENT promotion
+    return wide * jnp.ones((64,), jnp.float32)
+
+
+def wide_times_wide():
+    a = jnp.zeros((8,), jnp.float32)
+    b = jnp.ones((8,), jnp.float32)
+    return a + b

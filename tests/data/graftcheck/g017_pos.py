@@ -1,0 +1,29 @@
+"""Known-positive G017 silent-promotion cases.  # graftcheck: hot-module"""
+import jax.numpy as jnp
+
+
+def widen_in_score():
+    table = jnp.zeros((64,), jnp.bfloat16)
+    scale = jnp.ones((64,), jnp.float32)
+    return table * scale  # EXPECT: G017
+
+
+def int8_meets_f32(x):
+    q = jnp.zeros((16,), jnp.int8)
+    wide = jnp.ones((16,), jnp.float32)
+    return q + wide  # EXPECT: G017
+
+
+def _load_quantized():
+    return jnp.zeros((16,), jnp.float16)
+
+
+def widen_through_helper():
+    q = _load_quantized()
+    deq = q - jnp.zeros((16,), jnp.float32)  # EXPECT: G017
+    return deq
+
+
+def widen_via_binary_call():
+    q = jnp.ones((8,), jnp.bfloat16)
+    return jnp.maximum(q, jnp.zeros((8,), jnp.float64))  # EXPECT: G017

@@ -1,0 +1,38 @@
+"""Known-negative G018 cases: pinned dtypes and trusted forms.
+
+# graftcheck: serving-module
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def pinned_payload(instances):
+    return np.asarray(instances, np.float32)
+
+
+def pinned_zeros(n):
+    return np.zeros(n, np.float32)
+
+
+def pinned_kwarg(n):
+    return np.zeros((n, 4), dtype=np.int32)
+
+
+def jnp_defaults_are_f32(n):
+    return jnp.zeros((n,))
+
+
+def follows_input(x):
+    return np.asarray(x)  # dtype follows the input: trusted
+
+
+def like_follows_input(x):
+    return np.zeros_like(x)
+
+
+def int_fill(n):
+    return np.full((n,), 0)  # int fill: no float64 default
+
+
+def dynamic_args(shape_args):
+    return np.zeros(*shape_args)  # *args may carry the dtype: trusted

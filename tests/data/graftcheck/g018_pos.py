@@ -1,0 +1,26 @@
+"""Known-positive G018 f64-leak cases.  # graftcheck: serving-module"""
+import numpy as np
+
+
+def stage_request(instances, n_features):
+    return np.asarray(instances, np.float64).reshape(-1, n_features)  # EXPECT: G018
+
+
+def pad_labels(n):
+    return np.zeros(n)  # EXPECT: G018
+
+
+def empty_scores(n):
+    return np.zeros((0, n))  # EXPECT: G018
+
+
+def ones_buffer(n):
+    return np.ones(n)  # EXPECT: G018
+
+
+def cast_table(w):
+    return w.astype(float)  # EXPECT: G018
+
+
+def float_fill(n):
+    return np.full((n,), 0.5)  # EXPECT: G018

@@ -1,0 +1,36 @@
+"""Known-negative G019 cases: loop-variant receivers, hoisted casts,
+narrowing casts, and unknown dtypes are trusted.
+
+# graftcheck: hot-module
+"""
+import jax.numpy as jnp
+
+
+def hoisted_cast(table, blocks):
+    t = table.astype(jnp.float32)  # once, above the loop
+    out = []
+    for blk in blocks:
+        out.append(t[blk])
+    return out
+
+
+def loop_variant_receiver(x, blocks):
+    for blk in blocks:
+        x = x.astype(jnp.float32)[blk]  # x rebound each iteration
+    return x
+
+
+def narrowing_cast_is_the_goal():
+    acc = jnp.zeros((256,), jnp.float32)
+    return acc.astype(jnp.bfloat16)  # the storage-policy write
+
+
+def unknown_receiver(table):
+    return table.astype(jnp.float32)  # param dtype unknown: trusted
+
+
+def loop_target_cast(chunks):
+    out = []
+    for c in chunks:
+        out.append(c.astype(jnp.float32))  # casts a DIFFERENT chunk each time
+    return out

@@ -1,0 +1,26 @@
+"""Known-positive G019 cast-in-loop / materializing-dequant cases.
+
+# graftcheck: hot-module
+"""
+import jax.numpy as jnp
+
+
+def cast_per_step(table, blocks):
+    out = []
+    for blk in blocks:
+        t = table.astype(jnp.float32)  # EXPECT: G019
+        out.append(t[blk])
+    return out
+
+
+def cast_per_poll(table, ready):
+    total = table
+    while ready():
+        total = total + table.astype(jnp.float32)  # EXPECT: G019
+    return total
+
+
+def materializing_dequant(blocks):
+    q = jnp.zeros((1 << 20,), jnp.bfloat16)
+    wide = q.astype(jnp.float32)  # EXPECT: G019
+    return [wide[b] for b in blocks]

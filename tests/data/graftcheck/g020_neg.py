@@ -1,0 +1,30 @@
+"""Known-negative G020 cases: pinned reloads and host-side pack uses.
+
+# graftcheck: artifact-io
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def load_state_pinned(path, table_dt):
+    with np.load(path) as z:
+        return jnp.asarray(z["weights"], table_dt)
+
+
+def rebuild_pinned(artifact):
+    a = artifact.arrays
+    return jnp.asarray(a["w"], jnp.float32)
+
+
+def rebuild_kwarg_pinned(artifact):
+    a = artifact.arrays
+    return jnp.asarray(a["w"], dtype=jnp.bfloat16)
+
+
+def host_side_use(artifact):
+    a = artifact.arrays
+    return np.asarray(a["feature"], np.int64)  # numpy round-trips exactly
+
+
+def not_a_pack(rows):
+    return jnp.asarray(rows[0])  # plain sequence subscript: trusted

@@ -1,0 +1,27 @@
+"""Known-positive G020 dtype-unstable round-trip cases.
+
+# graftcheck: artifact-io
+"""
+import jax.numpy as jnp
+import numpy as np
+
+
+def load_state(path):
+    with np.load(path) as z:
+        return jnp.asarray(z["weights"])  # EXPECT: G020
+
+
+def rebuild_from_pack(artifact):
+    a = artifact.arrays
+    return jnp.asarray(a["w"])  # EXPECT: G020
+
+
+def rebuild_tuple_bound(artifact):
+    a, meta = artifact.arrays, artifact.meta
+    table = jnp.asarray(a["table"])  # EXPECT: G020
+    return table, meta
+
+
+def load_slots(path):
+    with np.load(path) as z:
+        return {k: jnp.asarray(z[k]) for k in z.files}  # EXPECT: G020

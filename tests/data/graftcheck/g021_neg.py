@@ -1,0 +1,41 @@
+"""Known-negative G021 cases: widened accumulators, f32 inputs, unknown
+dtypes, and non-accumulating ops.
+
+# graftcheck: hot-module
+"""
+import jax
+import jax.numpy as jnp
+
+
+def widened_accumulator():
+    x = jnp.ones((16384,), jnp.bfloat16)
+    return jnp.sum(x, dtype=jnp.float32)  # the sanctioned idiom
+
+
+def f32_sum():
+    x = jnp.ones((16384,), jnp.float32)
+    return jnp.sum(x)
+
+
+def unknown_operand(x):
+    return jnp.sum(x)  # param dtype unknown: trusted
+
+
+def f32_scatter_add(idx, upd):
+    acc = jnp.zeros((256,), jnp.float32)
+    return acc.at[idx].add(upd)
+
+
+def touch_max_is_not_accumulation(idx):
+    touched = jnp.zeros((256,), jnp.int8)
+    return touched.at[idx].max(1)  # max: no absorbed-update error
+
+
+def widened_method_sum():
+    x = jnp.ones((512,), jnp.float16)
+    return x.sum(dtype=jnp.float32)
+
+
+def f32_segment_sum(seg):
+    vals = jnp.ones((512,), jnp.float32)
+    return jax.ops.segment_sum(vals, seg, num_segments=64)

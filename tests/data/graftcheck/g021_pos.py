@@ -1,0 +1,31 @@
+"""Known-positive G021 low-precision-accumulation cases.
+
+# graftcheck: hot-module
+"""
+import jax
+import jax.numpy as jnp
+
+
+def bf16_sum():
+    x = jnp.ones((16384,), jnp.bfloat16)
+    return jnp.sum(x)  # EXPECT: G021
+
+
+def f16_cumsum():
+    x = jnp.ones((1024,), jnp.float16)
+    return x.cumsum()  # EXPECT: G021
+
+
+def bf16_mean():
+    x = jnp.ones((4096,), jnp.bfloat16)
+    return x.mean()  # EXPECT: G021
+
+
+def bf16_scatter_add(idx, upd):
+    acc = jnp.zeros((256,), jnp.bfloat16)
+    return acc.at[idx].add(upd)  # EXPECT: G021
+
+
+def bf16_segment_sum(seg):
+    vals = jnp.ones((512,), jnp.bfloat16)
+    return jax.ops.segment_sum(vals, seg, num_segments=64)  # EXPECT: G021

@@ -31,7 +31,8 @@ EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z0-9,\s]+)")
 
 RULES = ["g001", "g002", "g003", "g004", "g005", "g006",
          "g007", "g008", "g009", "g010", "g011",
-         "g012", "g013", "g014", "g015", "g016"]
+         "g012", "g013", "g014", "g015", "g016",
+         "g017", "g018", "g019", "g020", "g021"]
 
 # the four hot-path modules the acceptance criteria pin at zero G001/G002
 HOT_MODULES = [
@@ -322,6 +323,100 @@ def test_fixer_round_trip_g015_daemon(tmp_path):
         cwd=REPO, capture_output=True, text=True, timeout=120)
     assert proc2.returncode == 0, proc2.stdout + proc2.stderr
     assert "no applicable fixes" in proc2.stdout
+
+
+def test_fixer_round_trip_g018_f64(tmp_path):
+    """--fix on the G018 positive fixture: np.float64 tokens rewrite to
+    np.float32, dtype-less numpy constructors gain dtype=np.float32, the
+    unfixable astype(float) finding survives without a fix, and the whole
+    operation is idempotent (--fix-check agrees afterwards)."""
+    import shutil
+
+    target = tmp_path / "g018_case.py"
+    shutil.copy(os.path.join(DATA, "g018_pos.py"), target)
+    proc = subprocess.run(
+        [sys.executable, "-m", "hivemall_tpu.analysis", str(target),
+         "--fix", "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "--- a/" in proc.stdout, "fix must print a diff preview"
+    fixed = target.read_text()
+    assert "np.float64" not in fixed
+    assert "np.asarray(instances, np.float32)" in fixed
+    assert "np.zeros(n, dtype=np.float32)" in fixed
+    assert "np.zeros((0, n), dtype=np.float32)" in fixed
+    assert "np.ones(n, dtype=np.float32)" in fixed
+    assert "np.full((n,), 0.5, dtype=np.float32)" in fixed
+    remaining = [f for f in analyze_paths([str(target)])
+                 if f.rule == "G018"]
+    assert len(remaining) == 1, "only astype(float) may remain"
+    assert remaining[0].fix is None
+    # idempotence under --fix-check: after --fix, a check run plans
+    # NOTHING (exit 0) and the file is untouched — a second --fix would
+    # therefore be a no-op by construction
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "hivemall_tpu.analysis", str(target),
+         "--fix-check", "--no-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "no applicable fixes" in proc2.stdout
+    assert target.read_text() == fixed
+
+
+def test_ops_and_serving_are_dtype_clean():
+    """Acceptance (v4): the dogfooded hot-path and serving/IO modules carry
+    ZERO non-baselined G017-G021 findings — the engine.py f64 request
+    staging and the unpinned artifact reloads were FIXED in this PR — and
+    none of the new-rule debt hides in the baseline either (the dtype
+    contract the quantized-artifact work builds on)."""
+    paths = [os.path.join(PKG, "ops"),
+             os.path.join(PKG, "kernels"),
+             os.path.join(PKG, "serving"),
+             os.path.join(PKG, "io")]
+    dtype_rules = ("G017", "G018", "G019", "G020", "G021")
+    hits = [f for f in analyze_paths(paths) if f.rule in dtype_rules]
+    assert hits == [], "\n".join(f.format() for f in hits)
+    baselined = [b for b in load_baseline() if b.rule in dtype_rules]
+    assert baselined == [], \
+        "dtype/precision debt must be fixed, not baselined"
+
+
+def test_output_flag_writes_sarif_artifact(tmp_path):
+    """--format sarif --output FILE (the scripts/lint.sh CI wiring): the
+    SARIF payload lands in the file, stdout keeps the text summary, and
+    the exit code still reflects the findings."""
+    out = tmp_path / "analysis.sarif"
+    proc = subprocess.run(
+        [sys.executable, "-m", "hivemall_tpu.analysis",
+         os.path.join(DATA, "g018_pos.py"), "--no-baseline",
+         "--format", "sarif", "--output", str(out)],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr  # findings exist
+    assert "G018" in proc.stdout, "stdout keeps the text rendering"
+    assert f"sarif written to {out}" in proc.stdout
+    payload = json.loads(out.read_text())
+    assert payload["version"] == "2.1.0"
+    results = payload["runs"][0]["results"]
+    assert results and {r["ruleId"] for r in results} == {"G018"}
+    # --output with the default text format is a loud usage error — a CI
+    # step would otherwise upload a stale artifact from a previous run
+    proc3 = subprocess.run(
+        [sys.executable, "-m", "hivemall_tpu.analysis",
+         os.path.join(DATA, "g018_pos.py"), "--no-baseline",
+         "--output", str(tmp_path / "nope.txt")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc3.returncode == 2
+    assert "--output requires --format" in proc3.stderr
+    assert not (tmp_path / "nope.txt").exists()
+    # fix/baseline modes return before any report write — same loud error
+    proc4 = subprocess.run(
+        [sys.executable, "-m", "hivemall_tpu.analysis",
+         os.path.join(DATA, "g018_pos.py"), "--no-baseline", "--fix-check",
+         "--format", "sarif", "--output", str(tmp_path / "nope.sarif")],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc4.returncode == 2
+    assert "--output applies to report runs only" in proc4.stderr
+    assert not (tmp_path / "nope.sarif").exists()
 
 
 def test_sarif_output_is_valid_2_1_0():

@@ -137,3 +137,83 @@ def test_live_model_served_without_freezing(tmp_path):
     m = train_arow(ROWS, LABELS, "-dims 256")
     eng = ServingEngine(m, name="live_direct", max_batch=16, max_width=16)
     assert np.array_equal(m.predict(ROWS), np.asarray(eng.predict(ROWS)))
+
+
+def test_bf16_manifest_serves_at_bf16_with_no_widened_staging(tmp_path):
+    """The graftcheck-v4 dtype contract (G018/G020 regression pin): a
+    bf16-manifest artifact must reload its table AT bf16 — the pack stores
+    it widened to f32, so an unpinned reload would silently serve wide —
+    and nothing on the score path may stage request payloads above f32."""
+    import json
+
+    import jax.numpy as jnp
+
+    from hivemall_tpu.models.classifier import train_arow
+    from hivemall_tpu.serving.artifact import MANIFEST_FILE
+    from hivemall_tpu.serving.engine import make_servable
+
+    m = train_arow(ROWS, LABELS, "-dims 256")
+    path = str(tmp_path / "v1")
+    freeze(m, path, name="bf16case", version="1")
+    # rewrite the manifest dtype the way a >2^24-dims (half-float policy)
+    # training run records it; meta is outside the sha256'd array pack
+    mpath = os.path.join(path, MANIFEST_FILE)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    assert manifest["meta"]["weights_dtype"] == "float32"
+    manifest["meta"]["weights_dtype"] = "bfloat16"
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+
+    sv = make_servable(load(path))
+    assert sv.state.weights.dtype == jnp.bfloat16  # pinned from manifest
+    assert sv.state.covars.dtype == jnp.bfloat16
+    staged = sv.stage(ROWS[:4], 8, 16)
+    assert staged.values.dtype == np.float32  # request payloads stay f32
+    assert staged.labels.dtype == np.float32
+
+    # the f32-manifest artifact still reloads f32 (default pin is a no-op)
+    path32 = str(tmp_path / "v1_f32")
+    freeze(m, path32, name="f32case", version="1")
+    sv32 = make_servable(load(path32))
+    assert sv32.state.weights.dtype == jnp.float32
+    eng = ServingEngine(sv32, name="f32case", max_batch=16, max_width=16)
+    assert np.array_equal(m.predict(ROWS), np.asarray(eng.predict(ROWS)))
+
+
+def test_tree_serving_stages_f32_payloads(tmp_path):
+    """G018 dogfood regression: the tree families' request staging and the
+    GBT intercept are f32 (they were np.float64 — doubling host staging
+    bandwidth for precision the binned walk never uses), with bin edges
+    narrowed alongside so training-valued instances still bin exactly."""
+    from hivemall_tpu.models.trees.forest import \
+        train_gradient_tree_boosting_classifier
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(60, 4)
+    y = (X[:, 0] + X[:, 1] > 1).astype(int)
+    m = train_gradient_tree_boosting_classifier(X, y, "-trees 3 -seed 1")
+    path = str(tmp_path / "gbt")
+    freeze(m, path)
+    sv = __import__("hivemall_tpu.serving.engine",
+                    fromlist=["make_servable"]).make_servable(load(path))
+    assert sv.intercept.dtype == np.float32
+    assert all(b.edges.dtype == np.float32 for b in sv.bins)
+    staged = sv.stage(X[:8].tolist(), 8, 16)
+    assert staged.dtype == np.int32  # binned ids, no wide float residue
+
+
+def test_tree_serving_keeps_f64_when_quantitative_edges_collapse():
+    """The f32 narrowing is guarded for EVERY bin, not just nominal ones:
+    quantile edges of a large-magnitude quantitative feature (f32 spacing
+    at 1.7e9 is 128) can collapse under f32, which would make a bin
+    unreachable and shift every neighbor — such models stay on the f64
+    staging path end to end."""
+    from hivemall_tpu.models.trees.binning import BinInfo
+    from hivemall_tpu.serving.engine import _TreeServable
+
+    edges = np.asarray([1.7e9, 1.7e9 + 40.0, 1.7e9 + 80.0], np.float64)
+    assert np.unique(edges.astype(np.float32)).size < len(edges)
+    sv = _TreeServable([], [BinInfo(False, edges, len(edges))])
+    assert sv.stage_dtype == np.float64
+    assert sv.bins[0].edges.dtype == np.float64  # edges NOT narrowed
