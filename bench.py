@@ -235,66 +235,105 @@ def _run_child(env_overrides: dict, timeout: float):
     return None
 
 
-def _probe_tpu(timeout: float = 75.0) -> str:
-    """Cheap child probe. Returns 'tpu' (relay serving), 'cpu' (jax came up
-    but on a host backend — no TPU is configured for this process, so
-    waiting longer cannot help), or 'dead' (backend init hung or crashed —
-    the relay is configured but not serving right now). A dead relay hangs
-    backend init, so a full measurement attempt against it wastes its whole
-    timeout — probe first."""
+def _last_stderr_line(stderr) -> str:
+    """Last non-empty stderr line, bounded — the one line that usually
+    names the actual relay failure (connection refused, version skew, ...)."""
+    for line in reversed((stderr or "").strip().splitlines()):
+        line = line.strip()
+        if line:
+            return line[:300]
+    return ""
+
+
+def _probe_tpu(timeout: float = 75.0):
+    """Cheap child probe. Returns ``(verdict, cause)``: verdict is 'tpu'
+    (relay serving), 'cpu' (jax came up but on a host backend — no TPU is
+    configured for this process, so waiting longer cannot help), or 'dead'
+    (backend init hung or crashed — the relay is configured but not serving
+    right now). A dead relay hangs backend init, so a full measurement
+    attempt against it wastes its whole timeout — probe first.
+
+    ``cause`` is None for a serving relay, else {"exception": <class or
+    exit-code tag>, "stderr_last": <last stderr line>} — recorded into the
+    BENCH JSON device_set block so a CPU-fallback round is diagnosable from
+    the artifact instead of being a silent mystery (r03-r05 were exactly
+    that)."""
     code = "import jax; print('PLATFORM:' + jax.devices()[0].platform)"
     try:
         proc = subprocess.run([sys.executable, "-c", code],
                               capture_output=True, text=True, timeout=timeout,
                               cwd=os.path.dirname(os.path.abspath(__file__)))
-    except (subprocess.TimeoutExpired, OSError):
-        return "dead"
+    except subprocess.TimeoutExpired as e:
+        stderr = e.stderr
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode(errors="replace")
+        return "dead", {"exception": "TimeoutExpired",
+                        "stderr_last": _last_stderr_line(stderr)}
+    except OSError as e:
+        return "dead", {"exception": type(e).__name__,
+                        "stderr_last": str(e)[:300]}
     for line in proc.stdout.splitlines():
         if line.startswith("PLATFORM:"):
             plat = line.split(":", 1)[1].strip()
-            return "tpu" if plat == "tpu" else "cpu"
-    return "dead"
+            if plat == "tpu":
+                return "tpu", None
+            return "cpu", {"exception": f"HostBackend:{plat}",
+                           "stderr_last": _last_stderr_line(proc.stderr)}
+    return "dead", {"exception": f"ExitCode:{proc.returncode}",
+                    "stderr_last": _last_stderr_line(proc.stderr)}
 
 
-def _acquire_tpu_measurement() -> "dict | None":
+def _acquire_tpu_measurement() -> "tuple[dict | None, dict | None]":
     """Budget-bounded relay acquisition (VERDICT r4 weak #4): the relay's
     observed duty cycle is uptime windows of minutes separated by hours, so
     two probes at invocation time almost always miss it and the driver
     artifact records the CPU fallback. Instead, probe every ~2 minutes for
-    up to HIVEMALL_TPU_BENCH_TPU_ACQUIRE_S seconds (default 2400) and run
-    the measurement inside the first window that serves. A probe that lands
+    up to BENCH_TPU_BUDGET_S seconds (default 1500; the legacy
+    HIVEMALL_TPU_BENCH_TPU_ACQUIRE_S spelling still works) and run the
+    measurement inside the first window that serves. A probe that lands
     on a *host* backend exits the loop immediately — no relay is configured,
     so the wait can never pay off. Set the env var to 0 for the old
     probe-once behavior (the relay watcher does this: it only invokes
     bench.py when its own probe has already succeeded).
 
+    Returns ``(raw, probe_cause)``: raw is the TPU measurement dict or None
+    for CPU fallback; probe_cause is the LAST probe's failure cause (None on
+    success) — main() records it in the BENCH JSON device_set so a fallback
+    round names its reason in the artifact.
+
     The default budget (25 min) + the worst-case CPU fallback (~7 min)
     stays within any plausible driver bench window — an over-long
     acquisition that gets the whole process killed would leave NO artifact,
     which is strictly worse than a CPU-fallback line."""
-    budget = float(os.environ.get("HIVEMALL_TPU_BENCH_TPU_ACQUIRE_S", "1500"))
+    budget = float(os.environ.get(
+        "BENCH_TPU_BUDGET_S",
+        os.environ.get("HIVEMALL_TPU_BENCH_TPU_ACQUIRE_S", "1500")))
     interval = 120.0
     deadline = time.time() + budget
     first = True
+    cause = None
     while True:
-        verdict = _probe_tpu()
+        verdict, cause = _probe_tpu()
         if verdict == "tpu":
             print(f"bench: relay up at +{time.time() - deadline + budget:.0f}s"
                   "; measuring on TPU", file=sys.stderr)
             raw = _run_child({}, timeout=360)
             if raw is not None and raw.get("platform") == "tpu":
-                return raw
+                return raw, None
+            cause = {"exception": "MeasurementFailed",
+                     "stderr_last": "TPU probe served but the measurement "
+                                    "child did not return a tpu platform"}
             print("bench: TPU measurement attempt failed; will reprobe",
                   file=sys.stderr)
         elif verdict == "cpu":
             print("bench: jax came up on a host backend — no TPU relay "
                   "configured; skipping acquisition wait", file=sys.stderr)
-            return None
+            return None, cause
         remaining = deadline - time.time()
         if remaining <= 0:
             print(f"bench: TPU acquisition budget ({budget:.0f}s) exhausted; "
                   "falling back to CPU", file=sys.stderr)
-            return None
+            return None, cause
         if first:
             print(f"bench: relay down; probing every {interval:.0f}s for up "
                   f"to {budget:.0f}s", file=sys.stderr)
@@ -306,7 +345,7 @@ def main() -> None:
     # Budget-bounded TPU acquisition first (probe every ~2 min until the
     # relay serves or the budget runs out), then CPU with the relay scrubbed
     # so backend init cannot hang.
-    raw = _acquire_tpu_measurement()
+    raw, probe_cause = _acquire_tpu_measurement()
     if raw is None:
         from hivemall_tpu.relay_env import SCRUB_ENV
 
@@ -317,6 +356,11 @@ def main() -> None:
                "device_set": {"platform": "none", "device_count": 0,
                               "local_device_count": 0, "process_count": 0,
                               "device_kinds": []}}
+    if probe_cause is not None and isinstance(raw.get("device_set"), dict):
+        # name the relay failure in the artifact: a CPU-fallback round
+        # carries the probe's exception class + last stderr line instead of
+        # being a silent mystery (r03-r05)
+        raw["device_set"]["tpu_probe_failure"] = probe_cause
 
     try:
         anchors = _measure_anchors()

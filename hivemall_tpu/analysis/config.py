@@ -126,7 +126,14 @@ DTYPEFLOW_HOT_PREFIXES = (
     "hivemall_tpu/ops/",
     "hivemall_tpu/kernels/",
 )
-DTYPEFLOW_HOT_MODULES = ("hivemall_tpu/serving/engine.py",)
+# serving/engine.py carries the dequant-free score path (the _q8_* scorers
+# and every gathered-window cast); io/checkpoint.py carries the shared
+# quantization pack/unpack helpers (quantize_int8 / bf16_pack_raw) — both
+# are always hot for G017/G019 so a widened full-table copy or a silent
+# promotion in the quant plumbing fails tier-1 (scripts/lint.sh) before a
+# benchmark ever runs.
+DTYPEFLOW_HOT_MODULES = ("hivemall_tpu/serving/engine.py",
+                         "hivemall_tpu/io/checkpoint.py")
 HOT_MARKER = "# graftcheck: hot-module"
 
 # G018 scope: the serving/request path plus checkpoint IO — np.float64 (or a

@@ -114,6 +114,90 @@ def dtype_from_name(name):
     return name
 
 
+# --- quantized at-rest protocol (serving/artifact freeze(quantize=...)) -----
+# Two schemes share this module with np_saveable/dtype_from_name because
+# they are the same contract extended downward: the pack stores the
+# REDUCED representation losslessly, the manifest records how to read it,
+# and nothing between freeze and the score path ever materializes a
+# widened copy of a full table (graftcheck G019/G020).
+#
+# - bf16: raw uint16 bit patterns (np.savez cannot round-trip ml_dtypes,
+#   but a view can — exact bytes, half the widened-f32 pack);
+# - int8_absmax: per-block symmetric int8 with one f32 scale per block of
+#   `block_rows` (power of two) rows along the quantized axis, computed by
+#   absmax: scale = max(|block|) / 127, q = rint(x / scale). An all-zero
+#   block records scale 1.0 so dequantization is exactly zero; a tail
+#   block shorter than block_rows is padded with zeros for the reshape
+#   only (the pad never changes absmax and is sliced off the q output).
+
+QUANT_SCHEME_BF16 = "bf16"
+QUANT_SCHEME_INT8 = "int8_absmax"
+QUANT_BLOCK_ROWS = 64  # default scale-block granularity (power of two)
+SCALE_SUFFIX = "__scale"  # pack name of a quantized table's scale array
+
+
+def bf16_pack_raw(x) -> np.ndarray:
+    """bf16 table -> raw uint16 bit patterns, npz-stable without widening
+    (the quantized-artifact counterpart of np_saveable). A non-bf16 input
+    is rounded to bf16 first — that rounding IS the quantization."""
+    import jax.numpy as jnp
+
+    a = np.asarray(x)
+    if a.dtype.name != "bfloat16":
+        a = a.astype(jnp.bfloat16)
+    return a.view(np.uint16)
+
+
+def bf16_unpack_raw(u: np.ndarray) -> np.ndarray:
+    """Raw uint16 bit patterns back to a host bf16 array (a view, not a
+    cast — jnp.asarray of the result reloads at bf16 with zero copies of
+    anything widened)."""
+    import jax.numpy as jnp
+
+    return np.ascontiguousarray(np.asarray(u, np.uint16)).view(jnp.bfloat16)
+
+
+def quantize_int8(table, block_rows: int = QUANT_BLOCK_ROWS, axis: int = 0):
+    """Symmetric per-block int8 quantization along ``axis``.
+
+    Returns ``(q, scales)``: ``q`` is int8 with ``table``'s shape; ``scales``
+    is f32 with the same shape except the quantized axis collapses to
+    ``ceil(rows / block_rows)`` blocks. Row r of the table dequantizes as
+    ``q[r] * scales[r // block_rows]`` (axis-relative), which is exactly how
+    the serving scorers fold the scale into the gathered window — the full
+    table is never widened (graftcheck G019).
+    """
+    if block_rows <= 0 or block_rows & (block_rows - 1):
+        raise ValueError(f"block_rows must be a power of two: {block_rows}")
+    a = np.asarray(np_saveable(table), np.float32)
+    a = np.moveaxis(a, axis, 0)
+    rows = a.shape[0]
+    n_blocks = max(1, -(-rows // block_rows))
+    pad = n_blocks * block_rows - rows
+    if pad:  # tail block: zero-pad for the reshape only (absmax unchanged)
+        a = np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], np.float32)])
+    blocks = a.reshape((n_blocks, block_rows) + a.shape[1:])
+    absmax = np.max(np.abs(blocks), axis=1)  # [n_blocks, *rest]
+    # all-zero block: scale 1.0 keeps q == 0 dequantizing to exact zero
+    scales = np.where(absmax > 0.0, absmax / np.float32(127.0),
+                      np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(blocks / scales[:, None]), -127, 127).astype(np.int8)
+    q = q.reshape((n_blocks * block_rows,) + a.shape[1:])[:rows]
+    return np.moveaxis(q, 0, axis), np.moveaxis(scales, 0, axis)
+
+
+def dequantize_int8(q, scales, block_rows: int = QUANT_BLOCK_ROWS,
+                    axis: int = 0) -> np.ndarray:
+    """Host-side reference dequantization (tests / offline analysis; the
+    serving path never calls this on a full table — it folds the scale
+    into the gathered window instead)."""
+    qq = np.moveaxis(np.asarray(q), axis, 0)
+    ss = np.moveaxis(np.asarray(scales, np.float32), axis, 0)
+    per_row = np.repeat(ss, block_rows, axis=0)[: qq.shape[0]]
+    return np.moveaxis(qq.astype(np.float32) * per_row, 0, axis)
+
+
 def save_linear_state(path: str, state: LinearState) -> None:
     host = jax.device_get(state)
     arrays = {

@@ -68,6 +68,23 @@ def manifest_dtype(meta: dict, default: str = "float32"):
     return dtype_from_name(meta.get("weights_dtype", default))
 
 
+def manifest_quant(meta: dict) -> Optional[dict]:
+    """The manifest's quantization block, or None for full-precision
+    artifacts. Shape (recorded by ``freeze(..., quantize=...)``):
+
+        {"scheme": "bf16" | "int8_absmax",
+         "block_rows": 64,            # int8 scale-block rows (power of two)
+         "tables": ["weight", ...]}   # quantized pack entries
+
+    For int8, each quantized table name ``t`` has a sibling f32 scale
+    array ``t + io.checkpoint.SCALE_SUFFIX`` in the pack; for bf16, the
+    pack entry holds raw uint16 bit patterns (io.checkpoint.bf16_pack_raw).
+    This is the scale-carrying extension of the ``manifest_dtype`` pin:
+    the dtype says WHAT width the table serves at, the quant block says
+    how to read the reduced payload without ever widening it at rest."""
+    return meta.get("quant")
+
+
 def family_of(model) -> str:
     """Family tag for any trained model — the adapters/model_rows.py
     dispatch order, as a name."""
@@ -244,13 +261,108 @@ def _build_payload(model):
     return family, arrays, meta
 
 
+# Families with a float weight table the quantized serving path understands
+# (the sparse-row scorers + the MF embedding lookup). Trees walk int32
+# structure, FFM rides an opaque codec blob — neither has a weight table to
+# quantize, so freeze(quantize=...) refuses rather than silently no-ops.
+QUANTIZABLE_FAMILIES = ("linear", "multiclass", "fm", "mf")
+
+
+def _build_quantized_payload(model, quantize: str, block_rows: int):
+    """(family, arrays, meta) holding ONLY the score-path tables, reduced.
+
+    Quantized artifacts are serving-only by construction: the linear
+    covariance, FM regularizer/touched slots, and MF touched masks are
+    training state the scorers never read, and keeping them full-width
+    would erase most of the byte savings — they are dropped, and the
+    manifest's ``quant`` block records the layout (manifest_quant).
+    Weight tables store as:
+
+    - ``bf16``  — raw uint16 bit patterns (value-rounding to bf16 IS the
+      quantization; io.checkpoint.bf16_pack_raw);
+    - ``int8``  — per-block absmax int8 (io.checkpoint.quantize_int8)
+      with the f32 scale array alongside (``<name>__scale``), blocked
+      along the axis the scorers gather by (features for linear/fm and
+      multiclass, users/items for MF) so the serve path can fold
+      ``scales[id >> log2(block_rows)]`` into the dot product without
+      ever materializing a widened table.
+    """
+    from ..adapters.model_rows import iter_model_rows
+    from ..io.checkpoint import (QUANT_SCHEME_BF16, QUANT_SCHEME_INT8,
+                                 SCALE_SUFFIX, bf16_pack_raw, quantize_int8)
+
+    family = family_of(model)
+    if family not in QUANTIZABLE_FAMILIES:
+        raise ValueError(
+            f"freeze(quantize={quantize!r}): family {family!r} has no "
+            f"quantized serving path (supported: "
+            f"{', '.join(QUANTIZABLE_FAMILIES)})")
+    arrays: Dict[str, np.ndarray] = {}
+    meta: dict = {}
+    try:
+        meta["columns"], _ = iter_model_rows(model)
+    except ValueError:
+        meta["columns"] = None
+
+    # (pack name, host f32 table, quantized axis): the axis is the one the
+    # serving gather indexes by, so scale blocks align with gathered ids
+    if family == "linear":
+        tables = [("weight", _host(model.state.weights), 0)]
+        meta.update(dims=int(model.dims), rule=model.rule.name,
+                    use_covariance=False)  # covariance dropped: never scored
+    elif family == "multiclass":
+        tables = [("weights", _host(model.state.weights), 1)]
+        meta.update(dims=int(model.dims),
+                    label_vocab=_vocab_jsonable(model.label_vocab),
+                    use_covariance=False)
+    elif family == "fm":
+        st, hy = model.state, model.hyper
+        tables = [("w", _host(st.w), 0), ("v", _host(st.v), 0)]
+        arrays["w0"] = np.asarray(_host(st.w0), np.float32)
+        meta.update(dims=int(model.dims), factors=int(hy.factors),
+                    classification=bool(hy.classification))
+    else:  # mf
+        st = model.state
+        tables = [("P", _host(st.P), 0), ("Q", _host(st.Q), 0)]
+        for k in ("Bu", "Bi", "mu"):  # bias terms: tiny, stay f32
+            arrays[k] = np.asarray(_host(getattr(st, k)), np.float32)
+        meta.update(use_bias=bool(model.use_bias),
+                    num_users=int(st.P.shape[0]),
+                    num_items=int(st.Q.shape[0]),
+                    factor=int(st.P.shape[1]))
+
+    if quantize == "bf16":
+        for name, tab, _axis in tables:
+            arrays[name] = bf16_pack_raw(tab)
+        meta["weights_dtype"] = "bfloat16"
+        meta["quant"] = {"scheme": QUANT_SCHEME_BF16,
+                         "tables": [n for n, _, _ in tables]}
+    else:  # int8
+        for name, tab, axis in tables:
+            q, scales = quantize_int8(tab, block_rows, axis=axis)
+            arrays[name] = q
+            arrays[name + SCALE_SUFFIX] = scales
+        meta["weights_dtype"] = "int8"
+        meta["quant"] = {"scheme": QUANT_SCHEME_INT8,
+                         "block_rows": int(block_rows),
+                         "tables": [n for n, _, _ in tables]}
+    return family, arrays, meta
+
+
 def freeze(model, path: str, *, name: Optional[str] = None,
-           version: Optional[str] = None) -> dict:
+           version: Optional[str] = None, quantize: Optional[str] = None,
+           quant_block_rows: Optional[int] = None) -> dict:
     """Freeze a trained model into an immutable artifact directory.
 
     Returns the manifest. The directory must not already hold an artifact
     (versions are immutable — freeze a NEW directory and hot-swap it in via
     serving.server.ModelRegistry.deploy).
+
+    ``quantize="bf16"|"int8"`` stores the weight tables reduced (linear/
+    multiclass/FM/MF only; see _build_quantized_payload) — the serving
+    engine then scores them dequant-free at the manifest dtype.
+    ``quant_block_rows`` sets the int8 scale-block row count (power of
+    two; default io.checkpoint.QUANT_BLOCK_ROWS).
     """
     os.makedirs(path, exist_ok=True)
     mpath = os.path.join(path, MANIFEST_FILE)
@@ -258,7 +370,18 @@ def freeze(model, path: str, *, name: Optional[str] = None,
         raise FileExistsError(
             f"{mpath} exists — artifacts are immutable; freeze a new "
             f"version directory instead")
-    family, arrays, meta = _build_payload(model)
+    if quantize is None:
+        if quant_block_rows is not None:
+            raise ValueError("quant_block_rows requires quantize=")
+        family, arrays, meta = _build_payload(model)
+    elif quantize in ("bf16", "int8"):
+        from ..io.checkpoint import QUANT_BLOCK_ROWS
+
+        family, arrays, meta = _build_quantized_payload(
+            model, quantize, quant_block_rows or QUANT_BLOCK_ROWS)
+    else:
+        raise ValueError(f"quantize must be 'bf16' or 'int8', "
+                         f"got {quantize!r}")
     apath = os.path.join(path, ARRAYS_FILE)
     # savez into memory so the pack is written AND hashed in one pass (a
     # large FM/FFM table would otherwise pay a second full-file read)
@@ -324,6 +447,11 @@ def rebuild_model(artifact: Artifact):
     a, meta = artifact.arrays, artifact.meta
     family = artifact.family
 
+    if manifest_quant(meta) is not None:
+        raise ValueError(
+            f"rebuild_model: {family!r} artifact is quantized — there is no "
+            f"full-precision model to rebuild; serve it via "
+            f"serving.engine.make_servable (dequant-free score path)")
     if family == "ffm":
         from ..models.ffm import TrainedFFMModel
 

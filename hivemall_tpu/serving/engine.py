@@ -39,11 +39,11 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.batch import pack_rows, pad_to_bucket
+from ..core.batch import FeatureBlock, pack_rows, pad_to_bucket
 from ..runtime.metrics import REGISTRY, recompile_guard
 from ..runtime.tracing import TRACER
 from .artifact import Artifact, family_of, load, manifest_dtype, \
-    rebuild_model
+    manifest_quant, rebuild_model
 
 # serving latency is sub-ms-to-seconds shaped; finer low end than the
 # metrics default
@@ -52,10 +52,87 @@ LATENCY_BUCKETS = (0.0002, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
 
 
 # The serving dtype contract (graftcheck G017-G021, docs/static_analysis.md
-# "preparing for quantized artifacts"): request payloads and host staging are
-# f32, device tables reload at their MANIFEST dtype (artifact.manifest_dtype)
-# — never at whatever width the widened-at-rest pack happens to hold — and
-# nothing on the score path allocates f64.
+# "quantized artifacts"): request payloads and host staging are f32, device
+# tables reload at their MANIFEST dtype (artifact.manifest_dtype) — never at
+# whatever width the widened-at-rest pack happens to hold — and nothing on
+# the score path allocates f64. Quantized artifacts extend the contract
+# downward: bf16 tables serve AT bf16 through the families' own scorers
+# (the gathered window promotes to f32 inside the dot product), and int8
+# tables serve through the _q8_* scorers below, which gather the int8 rows,
+# widen ONLY that [B, K] window, and fold the per-block absmax scale into
+# the f32 accumulation — the full table is never dequantized (G019; the
+# per-window cast pattern of ops/mxu_scatter.py).
+
+
+_QUANT_JIT: dict = {}
+
+
+def _quant_jit_fns() -> dict:
+    """Build (once per process) the jitted dequant-free int8 scorers.
+
+    Shared across every engine instance the way the families' own scorers
+    are, so a second int8 model of the same shapes warms for free and
+    ``recompile_guard`` can watch one stable set of jit caches. Built
+    lazily: importing serving must not drag jax in before the engine is
+    actually used (the bench.py parent-process contract).
+
+    ``block_shift`` is static (= log2 of the manifest's scale-block rows),
+    so ``id >> block_shift`` resolves each gathered id to its scale block
+    with one shift — one extra tiny gather against the f32 scale array
+    replaces any widened copy of the table.
+    """
+    if _QUANT_JIT:
+        return _QUANT_JIT
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.fm import _row_predict
+
+    @partial(jax.jit, static_argnums=(4,))
+    def q8_linear_scores(qw, scales, indices, values, block_shift):
+        # per-window dequant: only the gathered [B, K] rows widen (G019),
+        # the scale folds into the product, and the sum accumulates f32
+        # (G021); pad lanes gather q=0 so they stay no-ops
+        wq = qw.at[indices].get(mode="fill", fill_value=0)
+        sg = scales.at[indices >> block_shift].get(mode="fill",
+                                                   fill_value=0.0)
+        return jnp.sum(wq.astype(jnp.float32) * sg * values, axis=-1)
+
+    @partial(jax.jit, static_argnums=(4,))
+    def q8_mc_scores(qW, scales, indices, values, block_shift):
+        # weights [L, D] int8, scales [L, nb] f32 (blocked along features,
+        # the gathered axis) — the [L, B, K] gathered window widens, the
+        # einsum accumulates f32
+        Wq = jnp.take(qW, indices, axis=1, mode="fill", fill_value=0)
+        S = jnp.take(scales, indices >> block_shift, axis=1, mode="fill",
+                     fill_value=0.0)
+        return jnp.einsum("lbk,bk->bl", Wq.astype(jnp.float32) * S, values)
+
+    @partial(jax.jit, static_argnums=(7,))
+    def q8_fm_scores(w0, qw, w_scales, qv, v_scales, indices, values,
+                     block_shift):
+        # same _row_predict core as the live FM scorer, fed per-row
+        # dequantized windows: w [D] and v [D, F] gather int8, widen the
+        # [K] / [K, F] window, fold the row-block scales
+        def one(idx, val):
+            sw = w_scales.at[idx >> block_shift].get(mode="fill",
+                                                     fill_value=0.0)
+            wg = qw.at[idx].get(mode="fill",
+                                fill_value=0).astype(jnp.float32) * sw
+            sv = v_scales.at[idx >> block_shift].get(mode="fill",
+                                                     fill_value=0.0)
+            vg = qv.at[idx].get(mode="fill",
+                                fill_value=0).astype(jnp.float32) * sv
+            p, _ = _row_predict(w0, wg, vg, val)
+            return p
+
+        return jax.vmap(one)(indices, values)
+
+    _QUANT_JIT.update(linear=q8_linear_scores, multiclass=q8_mc_scores,
+                      fm=q8_fm_scores)
+    return _QUANT_JIT
 
 
 class _Servable:
@@ -81,6 +158,28 @@ class _Servable:
     # families with a row-width axis warm up over width buckets; the rest
     # only have the batch axis
     has_width: bool = True
+    # the dtype the weight tables SERVE at (the manifest weights_dtype for
+    # artifacts) — surfaced per model on /models and /metrics
+    weights_dtype: str = "float32"
+
+    def device_tables(self):
+        """The resident score tables (arrays or pytrees of arrays) —
+        whatever a request's gathers actually read. Feeds table_bytes."""
+        return []
+
+    def table_bytes(self) -> int:
+        """Resident bytes of the score tables — the quantity bf16/int8
+        artifacts shrink 2-4x (reported per model on /models + /metrics
+        and in the bench_serving --quantize artifact)."""
+        import jax
+
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(self.device_tables()):
+            size = getattr(leaf, "size", None)
+            dt = getattr(leaf, "dtype", None)
+            if size is not None and dt is not None:
+                total += int(size) * np.dtype(dt).itemsize
+        return total
 
     def stage(self, instances, b_pad: int, width_cap: int):
         raise NotImplementedError
@@ -106,6 +205,48 @@ class _Servable:
         return sum(1 for r in instances if len(r) > width_cap)
 
 
+def _is_preparsed(instances) -> bool:
+    """Pre-parsed requests, honored end to end (sparse-row families only;
+    a LIST is always rows to parse):
+
+    - 2-TUPLE ``(idx_rows, val_rows)`` of per-row arrays — the
+      models.base._stage_rows convention;
+    - 3-TUPLE ``(flat_idx, flat_val, lens)`` — the same rows pre-packed
+      into flat arrays with per-row lengths, so staging needs no
+      per-request concatenate at all.
+
+    In-process callers (bench_serving --quantize, embedded scorers) skip
+    the string-parse cost per call this way — essential when the thing
+    being measured is table bandwidth, not tokenization."""
+    return isinstance(instances, tuple) and len(instances) in (2, 3)
+
+
+def _preparsed_len(instances) -> int:
+    """Row count of a pre-parsed request (either tuple form)."""
+    return len(instances[2] if len(instances) == 3 else instances[0])
+
+
+def _preparsed_offsets(instances):
+    """Element offsets for slicing a flat pre-parsed request — computed
+    ONCE per predict call (not per chunk: the cumsum is O(rows), and a
+    large offline predict chunks thousands of times)."""
+    if len(instances) == 2:
+        return None
+    lens = instances[2]
+    off = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(lens, out=off[1:])
+    return off
+
+
+def _preparsed_chunk(instances, s: int, e: int, off=None):
+    """Rows [s:e) of a pre-parsed request, preserving its form (the flat
+    form slices by the precomputed element offsets ``off``)."""
+    if len(instances) == 2:
+        return (instances[0][s:e], instances[1][s:e])
+    flat_i, flat_v, lens = instances
+    return (flat_i[off[s]:off[e]], flat_v[off[s]:off[e]], lens[s:e])
+
+
 class _SparseRowServable(_Servable):
     """Shared staging for the "feature[:value]" row families (linear,
     multiclass, FM): parse -> width-bucket -> one padded FeatureBlock.
@@ -114,7 +255,17 @@ class _SparseRowServable(_Servable):
     def __init__(self, dims: int) -> None:
         self.dims = dims
 
+    def count_overwide(self, instances, width_cap: int) -> int:
+        if _is_preparsed(instances):
+            if len(instances) == 3:
+                return int(np.count_nonzero(
+                    np.asarray(instances[2]) > width_cap))
+            instances = instances[0]
+        return sum(1 for r in instances if len(r) > width_cap)
+
     def stage(self, instances, b_pad: int, width_cap: int):
+        if _is_preparsed(instances):
+            return self._stage_preparsed(instances, b_pad, width_cap)
         from ..models.base import _stage_rows
 
         idx_rows, val_rows = _stage_rows(instances, self.dims)
@@ -122,6 +273,54 @@ class _SparseRowServable(_Servable):
         width = min(pad_to_bucket(self.max_nnz(idx_rows)), width_cap)
         return pack_rows(idx_rows, val_rows, np.zeros(n, dtype=np.float32),
                          self.dims, width=width, batch_size=b_pad)
+
+    def _stage_preparsed(self, instances, b_pad: int, width_cap: int):
+        """Vectorized staging for pre-parsed requests: one masked
+        [n, width] gather over the flattened rows replaces the per-row
+        Python loop of pack_rows. Semantics are identical (hash ids mod
+        dims, truncate rows past width_cap, pad lanes carry index == dims
+        with value 0) but the host cost drops to C-speed array ops — on
+        the quantized-serving bench the staging would otherwise price the
+        host side and bury the table-bandwidth difference the precisions
+        exist to change. The flat 3-tuple form skips even the
+        concatenate: for wide-batch requests the per-row-array overhead
+        alone is several ms."""
+        if len(instances) == 3:
+            flat_i, flat_v, lens = instances
+            n = len(lens)
+            lens = np.asarray(lens, np.int64)
+            flat_i = np.asarray(flat_i)
+            flat_v = np.asarray(flat_v, np.float32)
+        else:
+            idx_rows, val_rows = instances
+            n = len(idx_rows)
+            lens = np.fromiter((len(r) for r in idx_rows), np.int64,
+                               count=n)
+            flat_i = (np.concatenate(
+                [np.asarray(r, np.int64).ravel() for r in idx_rows])
+                if n else np.zeros(0, np.int64))
+            flat_v = (np.concatenate(
+                [np.asarray(r, np.float32).ravel() for r in val_rows])
+                if n else np.zeros(0, np.float32))
+        max_nnz = int(lens.max()) if n else 1
+        width = min(pad_to_bucket(max(1, max_nnz)), width_cap)
+        k = np.minimum(lens, width)
+        indices = np.full((b_pad, width), self.dims, dtype=np.int32)
+        values = np.zeros((b_pad, width), dtype=np.float32)
+        nnz = np.zeros(b_pad, dtype=np.int32)
+        total = int(lens.sum())
+        if total:
+            off = np.zeros(n, np.int64)
+            np.cumsum(lens[:-1], out=off[1:])
+            pos = np.arange(width, dtype=np.int64)
+            mask = pos[None, :] < k[:, None]
+            src = np.minimum(off[:, None] + pos[None, :], total - 1)
+            indices[:n] = np.where(mask, flat_i[src] % self.dims,
+                                   self.dims)
+            values[:n] = np.where(mask, flat_v[src], np.float32(0.0))
+        nnz[:n] = k.astype(np.int32)
+        return FeatureBlock(indices, values,
+                            np.zeros(b_pad, dtype=np.float32), nnz)
 
     def dummy_instance(self, width):
         return [(i, 1.0) for i in range(width)]
@@ -135,14 +334,32 @@ class _LinearServable(_SparseRowServable):
 
         super().__init__(dims)
         self.state = state
+        self.weights_dtype = np.dtype(state.weights.dtype).name
         self._predict = make_predict(use_covariance=False)
         self.jit_fns = (self._predict,)
 
     def dispatch(self, staged):
         return self._predict(self.state, staged.indices, staged.values)
 
+    def device_tables(self):
+        # weights only: the serving predict is built use_covariance=False,
+        # so a resident covariance table is reload baggage, not score-path
+        # bytes — counting it would overstate what requests actually gather
+        return [self.state.weights]
 
-class _MulticlassServable(_SparseRowServable):
+
+class _ArgmaxLabelServable(_SparseRowServable):
+    """Shared label selection for the multiclass servables (f32 and int8):
+    argmax over the [B, L] score matrix, mapped through label_vocab."""
+
+    label_vocab: list
+
+    def finalize(self, raw, n):
+        scores = np.asarray(raw)[:n]
+        return [self.label_vocab[i] for i in np.argmax(scores, axis=1)]
+
+
+class _MulticlassServable(_ArgmaxLabelServable):
     family = "multiclass"
 
     def __init__(self, state, label_vocab, dims: int) -> None:
@@ -151,6 +368,7 @@ class _MulticlassServable(_SparseRowServable):
         super().__init__(dims)
         self.state = state
         self.label_vocab = list(label_vocab)
+        self.weights_dtype = np.dtype(state.weights.dtype).name
         self._scores = _mc_scores
         self.jit_fns = (_mc_scores,)
 
@@ -158,9 +376,9 @@ class _MulticlassServable(_SparseRowServable):
         return self._scores(self.state.weights, staged.indices,
                             staged.values)
 
-    def finalize(self, raw, n):
-        scores = np.asarray(raw)[:n]
-        return [self.label_vocab[i] for i in np.argmax(scores, axis=1)]
+    def device_tables(self):
+        # _mc_scores reads the weight matrix only (see _LinearServable)
+        return [self.state.weights]
 
 
 class _FMServable(_SparseRowServable):
@@ -171,11 +389,15 @@ class _FMServable(_SparseRowServable):
 
         super().__init__(dims)
         self.state = state
+        self.weights_dtype = np.dtype(state.w.dtype).name
         self._scores = _fm_scores
         self.jit_fns = (_fm_scores,)
 
     def dispatch(self, staged):
         return self._scores(self.state, staged.indices, staged.values)
+
+    def device_tables(self):
+        return [self.state.w, self.state.v]
 
 
 class _FFMServable(_Servable):
@@ -188,6 +410,11 @@ class _FFMServable(_Servable):
         self.hyper = hyper
         self._scores = _ffm_scores_jit
         self.jit_fns = (_ffm_scores_jit,)
+
+    def device_tables(self):
+        # _row_predict reads v/w/w0; the FTRL optimizer slots riding on the
+        # state pytree are not score-path bytes
+        return [self.state.v, self.state.w, self.state.w0]
 
     def stage(self, instances, b_pad, width_cap):
         from ..utils.feature import FMFeature
@@ -215,16 +442,13 @@ class _FFMServable(_Servable):
         return [f"{k % 8}:{k}:1.0" for k in range(width)]
 
 
-class _MFServable(_Servable):
-    """Host-side embedding lookup — numpy gather-dot, bit-identical to
-    TrainedMFModel.predict; there is no [B, K] device batch shape to
-    bucket, so has_width is False and jit_fns is empty."""
+class _PairServable(_Servable):
+    """Shared (user, item) pair staging for the MF servables (f32 and
+    quantized): there is no [B, K] device batch shape to bucket, so
+    has_width is False and jit_fns is empty."""
 
     family = "mf"
     has_width = False
-
-    def __init__(self, model) -> None:
-        self.model = model
 
     def stage(self, instances, b_pad, width_cap):
         pairs = np.asarray(instances, np.int64).reshape(len(instances), 2)
@@ -234,12 +458,143 @@ class _MFServable(_Servable):
         i[:len(instances)] = pairs[:, 1]
         return u, i
 
+    def dummy_instance(self, width):
+        return (0, 0)
+
+
+class _MFServable(_PairServable):
+    """Host-side embedding lookup — numpy gather-dot, bit-identical to
+    TrainedMFModel.predict."""
+
+    def __init__(self, model) -> None:
+        self.model = model
+        self.weights_dtype = np.dtype(model.state.P.dtype).name
+
+    def device_tables(self):
+        return [self.model.state.P, self.model.state.Q,
+                self.model.state.Bu, self.model.state.Bi]
+
     def dispatch(self, staged):
         u, i = staged
         return self.model.predict(u, i)
 
-    def dummy_instance(self, width):
-        return (0, 0)
+
+class _QuantLinearServable(_SparseRowServable):
+    """int8 linear rows served dequant-free: gather the int8 window, fold
+    the per-block absmax scale into the f32 dot product (_quant_jit_fns)."""
+
+    family = "linear"
+    weights_dtype = "int8"
+
+    def __init__(self, qw, scales, block_rows: int, dims: int) -> None:
+        super().__init__(dims)
+        self.qw = qw
+        self.scales = scales
+        self.block_shift = int(block_rows).bit_length() - 1
+        self._scores = _quant_jit_fns()["linear"]
+        self.jit_fns = (self._scores,)
+
+    def dispatch(self, staged):
+        return self._scores(self.qw, self.scales, staged.indices,
+                            staged.values, self.block_shift)
+
+    def device_tables(self):
+        return [self.qw, self.scales]
+
+
+class _QuantMulticlassServable(_ArgmaxLabelServable):
+    """int8 multiclass [L, D] table, scales blocked along the feature
+    axis; argmax label selection shared with _MulticlassServable."""
+
+    family = "multiclass"
+    weights_dtype = "int8"
+
+    def __init__(self, qW, scales, block_rows: int, label_vocab,
+                 dims: int) -> None:
+        super().__init__(dims)
+        self.qW = qW
+        self.scales = scales
+        self.label_vocab = list(label_vocab)
+        self.block_shift = int(block_rows).bit_length() - 1
+        self._scores = _quant_jit_fns()["multiclass"]
+        self.jit_fns = (self._scores,)
+
+    def dispatch(self, staged):
+        return self._scores(self.qW, self.scales, staged.indices,
+                            staged.values, self.block_shift)
+
+    def device_tables(self):
+        return [self.qW, self.scales]
+
+
+class _QuantFMServable(_SparseRowServable):
+    """int8 FM: w [D] and v [D, F] gather int8, the per-row-block scales
+    fold into the gathered windows, and the same _row_predict core as the
+    live scorer combines them (f32 throughout)."""
+
+    family = "fm"
+    weights_dtype = "int8"
+
+    def __init__(self, w0, qw, w_scales, qv, v_scales, block_rows: int,
+                 dims: int) -> None:
+        super().__init__(dims)
+        self.w0 = w0
+        self.qw = qw
+        self.w_scales = w_scales
+        self.qv = qv
+        self.v_scales = v_scales
+        self.block_shift = int(block_rows).bit_length() - 1
+        self._scores = _quant_jit_fns()["fm"]
+        self.jit_fns = (self._scores,)
+
+    def dispatch(self, staged):
+        return self._scores(self.w0, self.qw, self.w_scales, self.qv,
+                            self.v_scales, staged.indices, staged.values,
+                            self.block_shift)
+
+    def device_tables(self):
+        return [self.qw, self.w_scales, self.qv, self.v_scales]
+
+
+class _QuantMFServable(_PairServable):
+    """MF embedding lookup over reduced P/Q tables (bf16 or int8): gather
+    the requested rows, widen ONLY the gathered window to f32 — never the
+    table — and fold the int8 row-block scales when present. Host-side
+    numpy like _MFServable (no device batch work to amortize); pair
+    staging shared via _PairServable."""
+
+    def __init__(self, P, Q, Bu, Bi, mu, use_bias: bool, *,
+                 p_scales=None, q_scales=None, block_rows: int = 1,
+                 weights_dtype: str = "bfloat16") -> None:
+        self.P = P
+        self.Q = Q
+        self.Bu = Bu
+        self.Bi = Bi
+        self.mu = np.float32(mu)
+        self.use_bias = bool(use_bias)
+        self.p_scales = p_scales
+        self.q_scales = q_scales
+        self.block_shift = int(block_rows).bit_length() - 1
+        self.weights_dtype = weights_dtype
+
+    def _rows(self, table, scales, ids):
+        g = np.asarray(table[ids], np.float32)  # per-window widen (G019)
+        if scales is not None:
+            g = g * scales[ids >> self.block_shift]
+        return g
+
+    def dispatch(self, staged):
+        u, i = staged
+        out = np.sum(self._rows(self.P, self.p_scales, u)
+                     * self._rows(self.Q, self.q_scales, i),
+                     axis=-1) + self.mu
+        if self.use_bias:
+            out = out + self.Bu[u] + self.Bi[i]
+        return out
+
+    def device_tables(self):
+        return [t for t in (self.P, self.Q, self.p_scales, self.q_scales,
+                            self.Bu, self.Bi) if t is not None]
 
 
 class _TreeServable(_Servable):
@@ -274,6 +629,10 @@ class _TreeServable(_Servable):
         self.stacked = stack_trees(trees_flat) if trees_flat else None
         self._walk = predict_forest_binned
         self.jit_fns = (predict_forest_binned,)
+
+    def device_tables(self):
+        return ([self.stacked] if self.stacked is not None else []) + \
+            [b.edges for b in self.bins]
 
     def stage(self, instances, b_pad, width_cap):
         from ..models.trees.binning import bin_data
@@ -336,11 +695,99 @@ class _GBTServable(_TreeServable):
         return self.classes[np.argmax(scores, axis=1)]
 
 
+def _quant_servable_from_artifact(art: Artifact) -> _Servable:
+    """Quantized artifact -> dequant-free servable. bf16 tables reload AT
+    bf16 through the families' own scorers (raw uint16 bit patterns view
+    back losslessly — io.checkpoint.bf16_unpack_raw); int8 tables keep
+    their q arrays + f32 scales and score through the _q8_* kernels."""
+    import jax.numpy as jnp
+
+    from ..io.checkpoint import QUANT_SCHEME_BF16, QUANT_SCHEME_INT8, \
+        SCALE_SUFFIX, bf16_unpack_raw
+
+    meta, a = art.meta, art.arrays
+    quant = manifest_quant(meta)
+    fam = art.family
+    if quant["scheme"] == QUANT_SCHEME_BF16:
+        if fam == "linear":
+            from ..core.state import init_linear_state
+
+            state = init_linear_state(
+                int(meta["dims"]), use_covariance=False,
+                dtype=jnp.bfloat16,
+                initial_weights=bf16_unpack_raw(a["weight"]))
+            return _LinearServable(state, int(meta["dims"]))
+        if fam == "multiclass":
+            from ..models.multiclass import MulticlassState
+
+            W = jnp.asarray(bf16_unpack_raw(a["weights"]), jnp.bfloat16)
+            state = MulticlassState(
+                weights=W, covars=None,
+                touched=jnp.ones(W.shape, jnp.int8),
+                step=jnp.zeros((), jnp.int32))
+            return _MulticlassServable(state, meta["label_vocab"],
+                                       int(meta["dims"]))
+        if fam == "fm":
+            from ..models.fm import FMState
+
+            w = jnp.asarray(bf16_unpack_raw(a["w"]), jnp.bfloat16)
+            v = jnp.asarray(bf16_unpack_raw(a["v"]), jnp.bfloat16)
+            # training-only fields are placeholders: _fm_scores reads
+            # w0/w/v only, and the quantized payload dropped the rest
+            state = FMState(
+                w0=jnp.asarray(a["w0"], jnp.float32), w=w, v=v,
+                lambda_w0=jnp.zeros((), jnp.float32),
+                lambda_w=jnp.zeros((), jnp.float32),
+                lambda_v=jnp.zeros((v.shape[1],), jnp.float32),
+                touched=jnp.ones((w.shape[0],), jnp.int8),
+                step=jnp.zeros((), jnp.int32))
+            return _FMServable(state, int(meta["dims"]))
+        if fam == "mf":
+            return _QuantMFServable(
+                bf16_unpack_raw(a["P"]), bf16_unpack_raw(a["Q"]),
+                np.asarray(a["Bu"], np.float32),
+                np.asarray(a["Bi"], np.float32), float(a["mu"]),
+                bool(meta["use_bias"]), weights_dtype="bfloat16")
+    elif quant["scheme"] == QUANT_SCHEME_INT8:
+        br = int(quant["block_rows"])
+        if fam == "linear":
+            return _QuantLinearServable(
+                jnp.asarray(a["weight"], jnp.int8),
+                jnp.asarray(a["weight" + SCALE_SUFFIX], jnp.float32),
+                br, int(meta["dims"]))
+        if fam == "multiclass":
+            return _QuantMulticlassServable(
+                jnp.asarray(a["weights"], jnp.int8),
+                jnp.asarray(a["weights" + SCALE_SUFFIX], jnp.float32),
+                br, meta["label_vocab"], int(meta["dims"]))
+        if fam == "fm":
+            return _QuantFMServable(
+                jnp.asarray(a["w0"], jnp.float32),
+                jnp.asarray(a["w"], jnp.int8),
+                jnp.asarray(a["w" + SCALE_SUFFIX], jnp.float32),
+                jnp.asarray(a["v"], jnp.int8),
+                jnp.asarray(a["v" + SCALE_SUFFIX], jnp.float32),
+                br, int(meta["dims"]))
+        if fam == "mf":
+            return _QuantMFServable(
+                np.asarray(a["P"], np.int8), np.asarray(a["Q"], np.int8),
+                np.asarray(a["Bu"], np.float32),
+                np.asarray(a["Bi"], np.float32), float(a["mu"]),
+                bool(meta["use_bias"]),
+                p_scales=np.asarray(a["P" + SCALE_SUFFIX], np.float32),
+                q_scales=np.asarray(a["Q" + SCALE_SUFFIX], np.float32),
+                block_rows=br, weights_dtype="int8")
+    raise ValueError(f"unknown quantized artifact: family {fam!r}, "
+                     f"scheme {quant['scheme']!r}")
+
+
 def _servable_from_artifact(art: Artifact) -> _Servable:
     import jax.numpy as jnp
 
     meta = art.meta
     a = art.arrays
+    if manifest_quant(meta) is not None:
+        return _quant_servable_from_artifact(art)
     # every device table reloads at its MANIFEST dtype: the pack stores
     # reduced tables widened (value-exact), so asarray without a pin would
     # silently serve a bf16-trained model at 2x HBM traffic (G020)
@@ -428,6 +875,35 @@ def _servable_from_model(model) -> _Servable:
     raise ValueError(f"unknown family {family!r}")
 
 
+def _dtype_bits(name: str) -> int:
+    """Bits per element of a weights_dtype name (bf16 is not a stock numpy
+    dtype string, so map it explicitly)."""
+    if name == "bfloat16":
+        return 16
+    try:
+        return int(np.dtype(name).itemsize) * 8
+    except TypeError:
+        return 32
+
+
+# Warmup dummy instances keyed by bucket shape, shared across engines:
+# deploying N same-family models re-warms the same (batch, width) mesh, and
+# re-CONSTRUCTING the dummy rows per model is pure host-side waste (jit
+# caches are already shared — see the module docstring). dummy_instance is
+# shape-determined (family + width + feature count), so one construction
+# serves every model. Plain dict mutation is GIL-atomic; a racing deploy at
+# worst constructs one duplicate.
+_WARMUP_DUMMIES: dict = {}
+
+
+def _warmup_dummy(servable: _Servable, width: int):
+    key = (servable.family, width, getattr(servable, "n_features", None))
+    inst = _WARMUP_DUMMIES.get(key)
+    if inst is None:
+        inst = _WARMUP_DUMMIES[key] = servable.dummy_instance(width)
+    return inst
+
+
 def make_servable(obj) -> _Servable:
     """Artifact | artifact dir path | trained model -> family servable."""
     if isinstance(obj, str):
@@ -463,6 +939,15 @@ class ServingEngine:
         self._rows = REGISTRY.counter("serving", f"{name}.rows")
         self._truncated = REGISTRY.counter("serving", f"{name}.truncated_rows")
         self.warmed_buckets: List[Tuple[int, Optional[int]]] = []
+        # per-model precision surface (/models + /metrics): the dtype the
+        # tables serve at and the resident bytes a request's gathers read —
+        # what bf16/int8 artifacts shrink 2-4x
+        self.weights_dtype = self.servable.weights_dtype
+        self.table_bytes = int(self.servable.table_bytes())
+        REGISTRY.set_gauge(f"serving.{name}.table_bytes",
+                           float(self.table_bytes))
+        REGISTRY.set_gauge(f"serving.{name}.weights_bits",
+                           float(_dtype_bits(self.weights_dtype)))
 
     # -- buckets -------------------------------------------------------------
 
@@ -504,7 +989,10 @@ class ServingEngine:
                 recompile_guard(f"serving.{self.name}.warmup",
                                 *self.servable.jit_fns) as g:
             for width in self.width_buckets():
-                inst = self.servable.dummy_instance(width or 8)
+                # dummy construction is keyed by bucket shape and shared
+                # across engines (_WARMUP_DUMMIES) — pure host-side dedup,
+                # the jit-cache semantics are untouched
+                inst = _warmup_dummy(self.servable, width or 8)
                 for b in self.batch_buckets():
                     raw = self.servable.run_padded([inst], b, self.max_width)
                     self.servable.finalize(raw, 1)
@@ -520,8 +1008,17 @@ class ServingEngine:
         chunk's path is traced stage by stage — bucket selection, host
         pad, device dispatch, host block — as child spans of whatever
         request span is active (runtime/tracing.py), so a slow predict is
-        attributable from the trace alone."""
-        n = len(instances)
+        attributable from the trace alone.
+
+        ``instances`` is a list of rows, or — for the sparse-row families
+        ONLY (other families treat any tuple as a plain sequence of rows)
+        — a pre-parsed tuple: ``(idx_rows, val_rows)`` per-row arrays (the
+        ``models.base._stage_rows`` convention) or the flat
+        ``(flat_idx, flat_val, lens)`` packed form (see _is_preparsed)."""
+        pre = (isinstance(self.servable, _SparseRowServable)
+               and _is_preparsed(instances))
+        off = _preparsed_offsets(instances) if pre else None
+        n = _preparsed_len(instances) if pre else len(instances)
         if n == 0:
             return []
         t0 = time.perf_counter()
@@ -530,15 +1027,22 @@ class ServingEngine:
                          args={"engine": self.name, "family": self.family,
                                "rows": n}) as pspan:
             for s in range(0, n, self.max_batch):
-                chunk = instances[s:s + self.max_batch]
+                if pre:
+                    chunk = _preparsed_chunk(instances, s,
+                                             min(s + self.max_batch, n),
+                                             off)
+                    chunk_n = _preparsed_len(chunk)
+                else:
+                    chunk = instances[s:s + self.max_batch]
+                    chunk_n = len(chunk)
                 with TRACER.span("engine.bucket") as bspan:
                     if self.servable.has_width:
                         overwide = self.servable.count_overwide(
                             chunk, self.max_width)
                         if overwide:
                             self._truncated.increment(overwide)
-                    b_pad = self.bucket_batch(len(chunk))
-                    bspan.set(rows=len(chunk), b_pad=b_pad)
+                    b_pad = self.bucket_batch(chunk_n)
+                    bspan.set(rows=chunk_n, b_pad=b_pad)
                 with TRACER.span("engine.pad", args={"b_pad": b_pad}):
                     staged = self.servable.stage(chunk, b_pad,
                                                  self.max_width)
@@ -550,7 +1054,7 @@ class ServingEngine:
                     # — this is where an async dispatch is actually waited
                     # on (block_until_ready by another name)
                     with TRACER.span("engine.block"):
-                        out = self.servable.finalize(raw, len(chunk))
+                        out = self.servable.finalize(raw, chunk_n)
                 outs.append(out)
             self._rows.increment(n)
             self._latency.observe(time.perf_counter() - t0,

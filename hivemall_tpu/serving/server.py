@@ -57,6 +57,12 @@ class ModelEntry:
             "deployed_unix": self.deployed_unix,
             "max_batch": self.engine.max_batch,
             "max_width": self.engine.max_width,
+            # the precision surface: what dtype the tables serve at and the
+            # resident bytes a request's gathers read (bf16/int8 artifacts
+            # shrink this 2-4x; also gauges serving.<name>.table_bytes /
+            # .weights_bits on /metrics)
+            "weights_dtype": self.engine.weights_dtype,
+            "table_bytes": self.engine.table_bytes,
         }
 
 

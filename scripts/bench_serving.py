@@ -33,6 +33,23 @@ serving_trace.json — load in ui.perfetto.dev) and embeds a per-stage
 the BENCH JSON, so a latency regression is attributable from the artifact
 alone; the smoke gate additionally fails unless the traces cover >= 4 of
 the request-path stage names (docs/observability.md).
+
+`--quantize` switches to the quantized-artifact parity bench instead: one
+model is frozen three ways (f32 / bf16 / int8 — serving/artifact
+freeze(quantize=...)), every precision warms its own engine, and the SAME
+pre-parsed request pool is driven through all three in interleaved paired
+trials, each trial a concurrent closed loop (precision order rotates per
+trial, so drift in the host's background load cancels in the per-trial
+ratios; concurrent drivers keep the memory system under serving-shaped
+pressure — the regime quantization exists for). Reported per precision:
+throughput, p50/p99 (+ deltas vs f32), artifact bytes on disk, resident
+table bytes, steady-state recompiles (must be zero — the bucket mesh is
+identical across precisions), and holdout logloss/AUC via
+evaluation/metrics.py. The int8-vs-f32 logloss delta is a HARD parity pin
+(`--parity-tol-logloss`): quantization that moves holdout logloss more
+than the tolerance fails the run whether or not --smoke is set — speed
+that costs accuracy is a regression, not a win (docs/serving.md
+"Quantized artifacts").
 """
 
 from __future__ import annotations
@@ -99,6 +116,244 @@ def _request_pool(rows, n_requests: int, k: int, seed: int = 13):
 def _percentiles(lat_s):
     lat_ms = np.asarray(lat_s) * 1000.0
     return {p: float(np.percentile(lat_ms, p)) for p in (50, 95, 99)}
+
+
+def _planted_weights(dims: int, seed: int = 5) -> np.ndarray:
+    return np.random.RandomState(seed).randn(dims).astype(np.float32)
+
+
+def _planted_rows(w_true: np.ndarray, n_rows: int, seed: int,
+                  noise: float = 0.5, nnz=(4, 14)):
+    """Pre-parsed rows + labels from a planted linear model: labels carry
+    real signal, so holdout logloss/AUC measure what quantization actually
+    costs (random labels would pin every precision at logloss ~0.69 and
+    hide it). Rows come back in the models.base ``(idx_rows, val_rows)``
+    pre-parsed convention — training, the request pool, and the holdout
+    all skip the "i:v" string round-trip, so what the trials price is
+    table gathers, not tokenization."""
+    dims = w_true.shape[0]
+    rng = np.random.RandomState(seed)
+    idx_rows, val_rows, labels = [], [], []
+    for _ in range(n_rows):
+        k = rng.randint(nnz[0], nnz[1])
+        idx = rng.randint(0, dims, k).astype(np.int64)
+        val = rng.rand(k).astype(np.float32)
+        margin = float(np.sum(w_true[idx] * val))
+        labels.append(1 if margin + noise * rng.randn() > 0 else -1)
+        idx_rows.append(idx)
+        val_rows.append(val)
+    return (idx_rows, val_rows), labels
+
+
+def _preparsed_pool(rows, n_requests: int, k: int, seed: int = 13):
+    """Requests sampled from pre-parsed rows, each in the engine's flat
+    ``(flat_idx, flat_val, lens)`` packed form — the request arrives
+    ready to stage, so the trials price staging + table gathers, never
+    per-row Python overhead."""
+    idx_rows, val_rows = rows
+    rng = np.random.RandomState(seed)
+    pool = []
+    for _ in range(n_requests):
+        take = rng.randint(1, k + 1)
+        sel = rng.randint(0, len(idx_rows), take)
+        pool.append((np.concatenate([idx_rows[i] for i in sel]),
+                     np.concatenate([val_rows[i] for i in sel]),
+                     np.fromiter((len(idx_rows[i]) for i in sel),
+                                 np.int64, count=take)))
+    return pool
+
+
+def _drive_closed_loop(eng, pool, concurrency: int):
+    """Drain the request pool through ``eng.predict`` with ``concurrency``
+    closed-loop driver threads. Returns (wall_seconds, per-request
+    latencies). Concurrency is part of the measurement, not just load:
+    serving hosts run hot, and it is exactly under memory pressure that a
+    4x-smaller weight table keeps its rows cached while the f32 table
+    thrashes — single-threaded trials systematically understate what
+    quantization buys a loaded server."""
+    lats: list = []
+    lock = threading.Lock()
+
+    def worker(shard):
+        local = []
+        for req in shard:
+            r0 = time.perf_counter()
+            eng.predict(req)
+            local.append(time.perf_counter() - r0)
+        with lock:
+            lats.extend(local)
+
+    shards = [pool[i::concurrency] for i in range(concurrency)]
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in shards if s]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, lats
+
+
+# the three serving precisions the parity bench compares, in the fixed
+# reference order (trial t rotates the EXECUTION order by t, so every
+# precision runs first equally often — host-load drift cancels in the
+# per-trial ratios)
+QUANT_PRECISIONS = ("float32", "bfloat16", "int8")
+_QUANT_FREEZE_ARG = {"float32": None, "bfloat16": "bf16", "int8": "int8"}
+
+
+def run_quantize_mode(args) -> int:
+    """Paired-trial f32 / bf16 / int8 parity bench on one frozen model.
+
+    The same trained AROW model freezes three ways; the same pre-parsed
+    request pool drives all three engines in interleaved paired trials,
+    each trial a concurrent closed loop (_drive_closed_loop) — wide rows
+    against a table sized past cache, because table bandwidth is the
+    quantity the precisions change. Hard gates: the int8 holdout logloss
+    must sit within --parity-tol-logloss of f32 (always — a parity break
+    fails the run even without --smoke), and under --smoke every precision
+    must additionally show zero steady-state recompiles across the whole
+    trial sweep.
+    """
+    import os
+    import tempfile
+
+    from hivemall_tpu.evaluation.metrics import auc, logloss
+    from hivemall_tpu.models.classifier import train_arow
+    from hivemall_tpu.serving import freeze
+
+    nnz = (4, 14) if args.smoke else (16, args.max_width + 1)
+    w_true = _planted_weights(args.dims)
+    train_rows, train_labels = _planted_rows(w_true, args.train_rows,
+                                             seed=7, nnz=nnz)
+    hold_rows, hold_labels = _planted_rows(w_true, args.holdout, seed=99,
+                                           nnz=nnz)
+    t0 = time.perf_counter()
+    model = train_arow(train_rows, train_labels, f"-dims {args.dims}")
+    train_s = time.perf_counter() - t0
+
+    tmp = tempfile.mkdtemp(prefix="hivemall_quant_bench_")
+    engines, disk_bytes, warm = {}, {}, {}
+    for prec in QUANT_PRECISIONS:
+        path = os.path.join(tmp, prec)
+        freeze(model, path, name=f"qbench_{prec}", version="1",
+               quantize=_QUANT_FREEZE_ARG[prec])
+        disk_bytes[prec] = sum(
+            os.path.getsize(os.path.join(path, f)) for f in os.listdir(path))
+        eng = ServingEngine(load(path), name=f"qbench_{prec}",
+                            max_batch=args.max_batch,
+                            max_width=args.max_width)
+        t0 = time.perf_counter()
+        compiles = eng.warmup()
+        warm[prec] = {"compiles": int(compiles),
+                      "seconds": round(time.perf_counter() - t0, 3)}
+        engines[prec] = eng
+
+    # holdout quality per precision: the margin through a sigmoid is the
+    # probability logloss scores; AUC ranks the raw margins
+    quality = {}
+    for prec, eng in engines.items():
+        scores = np.asarray(eng.predict(hold_rows), np.float32)
+        prob = 1.0 / (1.0 + np.exp(-scores))
+        quality[prec] = {"logloss": float(logloss(prob, hold_labels)),
+                         "auc": float(auc(scores, hold_labels))}
+
+    # interleaved paired trials over ONE shared pre-parsed request pool,
+    # each trial a concurrent closed loop — see _drive_closed_loop for why
+    # concurrency is part of the measurement
+    pool = _preparsed_pool(train_rows, args.requests,
+                           args.instances_per_request)
+    total_rows = sum(len(r[2]) for r in pool)  # r = (flat_i, flat_v, lens)
+    total_nnz = sum(int(np.sum(r[2])) for r in pool)
+    guards = {p: REGISTRY.counter("graftcheck",
+                                  f"recompiles.serving.qbench_{p}")
+              for p in QUANT_PRECISIONS}
+    recompiles0 = {p: guards[p].value for p in QUANT_PRECISIONS}
+    trials = {p: [] for p in QUANT_PRECISIONS}
+    lats = {p: [] for p in QUANT_PRECISIONS}
+    for t in range(args.quant_trials):
+        rot = t % len(QUANT_PRECISIONS)
+        for prec in QUANT_PRECISIONS[rot:] + QUANT_PRECISIONS[:rot]:
+            wall, trial_lats = _drive_closed_loop(engines[prec], pool,
+                                                  args.concurrency)
+            lats[prec].extend(trial_lats)
+            trials[prec].append(total_rows / wall)
+    steady = {p: int(guards[p].value - recompiles0[p])
+              for p in QUANT_PRECISIONS}
+
+    def paired_ratio(prec):
+        return float(np.median(np.asarray(trials[prec])
+                               / np.asarray(trials["float32"])))
+
+    pcts = {p: _percentiles(lats[p]) for p in QUANT_PRECISIONS}
+    precisions_block = {
+        p: {
+            "throughput_rows_per_sec": round(float(np.median(trials[p])), 1),
+            "p50_ms": round(pcts[p][50], 3),
+            "p99_ms": round(pcts[p][99], 3),
+            "artifact_bytes": int(disk_bytes[p]),
+            "resident_table_bytes": int(engines[p].table_bytes),
+            "weights_dtype": engines[p].weights_dtype,
+            "steady_state_recompiles": steady[p],
+            "warmup": warm[p],
+            "holdout_logloss": round(quality[p]["logloss"], 6),
+            "holdout_auc": round(quality[p]["auc"], 6),
+        } for p in QUANT_PRECISIONS
+    }
+    deltas = {
+        p: {
+            "throughput_x": round(paired_ratio(p), 3),
+            "p50_ms": round(pcts[p][50] - pcts["float32"][50], 3),
+            "p99_ms": round(pcts[p][99] - pcts["float32"][99], 3),
+            "logloss": round(quality[p]["logloss"]
+                             - quality["float32"]["logloss"], 6),
+            "auc": round(quality[p]["auc"] - quality["float32"]["auc"], 6),
+            "artifact_bytes_x": round(disk_bytes[p]
+                                      / max(1, disk_bytes["float32"]), 3),
+            "resident_table_bytes_x": round(
+                engines[p].table_bytes
+                / max(1, engines["float32"].table_bytes), 3),
+        } for p in ("bfloat16", "int8")
+    }
+    int8_delta = abs(deltas["int8"]["logloss"])
+    bf16_delta = abs(deltas["bfloat16"]["logloss"])
+    parity_ok = (int8_delta <= args.parity_tol_logloss
+                 and bf16_delta <= args.parity_tol_logloss)
+    result = {
+        "metric": f"serving_int8_throughput_vs_f32_arow_{args.dims}dims",
+        "value": deltas["int8"]["throughput_x"],
+        "unit": "x",
+        "methodology": "interleaved_paired_trials_closed_loop_engine",
+        "trials": int(args.quant_trials),
+        "concurrency": int(args.concurrency),
+        "requests_per_trial": len(pool),
+        "rows_per_trial": int(total_rows),
+        "nnz_per_trial": int(total_nnz),
+        "train": {"rows": len(train_rows[0]), "seconds": round(train_s, 3)},
+        "holdout_rows": len(hold_rows[0]),
+        "precisions": precisions_block,
+        "deltas_vs_f32": deltas,
+        "parity": {
+            "tolerance_logloss": args.parity_tol_logloss,
+            "int8_logloss_delta": round(int8_delta, 6),
+            "bf16_logloss_delta": round(bf16_delta, 6),
+            "ok": parity_ok,
+        },
+    }
+    print(json.dumps(result))
+
+    if not parity_ok:
+        # parity is a hard pin with or without --smoke: quantization that
+        # moves holdout logloss past the tolerance is a regression
+        print(f"PARITY FAIL: int8 logloss delta {int8_delta:.6f} / bf16 "
+              f"{bf16_delta:.6f} vs tolerance {args.parity_tol_logloss}",
+              file=sys.stderr)
+        return 1
+    if args.smoke and any(steady.values()):
+        print(f"SMOKE FAIL: steady_state_recompiles={steady}",
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def closed_loop(batcher, pool, concurrency: int):
@@ -379,7 +634,9 @@ def main() -> int:
                     help="default 2000 (300 under --smoke)")
     ap.add_argument("--requests", type=int, default=None,
                     help="default 2000 (300 under --smoke)")
-    ap.add_argument("--instances-per-request", type=int, default=8)
+    ap.add_argument("--instances-per-request", type=int, default=None,
+                    help="max rows per request; default 8 (1024 in the "
+                         "full --quantize bench, 4 in its smoke)")
     ap.add_argument("--concurrency", type=int, default=None,
                     help="default 8 (4 under --smoke)")
     ap.add_argument("--rate", type=float, default=None,
@@ -397,6 +654,20 @@ def main() -> int:
                     help="drive POST /predict end-to-end (registry + HTTP "
                          "endpoint in-process) instead of calling the "
                          "engine directly")
+    ap.add_argument("--quantize", action="store_true",
+                    help="paired-trial f32/bf16/int8 parity bench on one "
+                         "frozen model (freeze(quantize=...)); hard-fails "
+                         "when int8 holdout logloss drifts past "
+                         "--parity-tol-logloss")
+    ap.add_argument("--quant-trials", type=int, default=None,
+                    help="paired trials per precision; default 5 "
+                         "(3 under --smoke)")
+    ap.add_argument("--holdout", type=int, default=None,
+                    help="holdout rows for the logloss/AUC parity pin; "
+                         "default 4000 (300 under --smoke)")
+    ap.add_argument("--parity-tol-logloss", type=float, default=0.02,
+                    help="max |holdout logloss - f32 logloss| a quantized "
+                         "precision may show (hard gate)")
     ap.add_argument("--trace-out", default=None,
                     help="write the request traces as Chrome/Perfetto JSON "
                          "here (default serving_trace.json under --http; "
@@ -408,10 +679,53 @@ def main() -> int:
     sizing = {"dims": (1 << 16, 1 << 10), "train_rows": (2000, 300),
               "requests": (2000, 300), "concurrency": (8, 4),
               "rate": (500.0, 300.0), "max_batch": (256, 64),
-              "max_width": (64, 32)}
+              "max_width": (64, 32), "instances_per_request": (8, 8),
+              "quant_trials": (5, 3),
+              "holdout": (4000, 300)}
+    if args.quantize:
+        # the quantized bench sizes for table-bandwidth sensitivity: a
+        # 2^24-dim f32 weight table (64 MB) is past any cache this host
+        # has, wide (16-64 nnz) rows and 1024-row batches amortize
+        # dispatch into gather traffic, and per-core closed-loop drivers
+        # keep the memory system under serving-shaped pressure; training
+        # densely enough (~100k wide rows) that the tables hold real
+        # weights, so on-disk compression compares trained bytes, not
+        # runs of zeros. --smoke keeps the tiny parity-gate shape.
+        # concurrency 0 = resolve to the host's core count below (the
+        # drivers are request-level parallelism under 1-thread XLA ops)
+        sizing.update({"dims": (1 << 24, 1 << 10),
+                       "train_rows": (100000, 300),
+                       "requests": (1200, 200),
+                       "concurrency": (0, 2),
+                       "max_batch": (1024, 64),
+                       "instances_per_request": (1024, 4)})
     for name, (full, small) in sizing.items():
         if getattr(args, name) is None:
             setattr(args, name, small if args.smoke else full)
+
+    if args.quantize:
+        if args.artifact or args.http:
+            raise SystemExit("--quantize freezes its own model at three "
+                             "precisions; it does not compose with "
+                             "--artifact or --http")
+        import os
+
+        # serving-shaped XLA threading: production servers give each
+        # request one core (request-level parallelism) instead of letting
+        # every dispatch fan out over the whole intra-op pool — and it is
+        # under that per-core regime that table bytes, not the scheduler,
+        # price a request. Re-exec once with the CPU backend pinned to
+        # single-threaded ops before jax initializes; operators override
+        # by setting XLA_FLAGS themselves.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "intra_op_parallelism_threads" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_cpu_multi_thread_eigen=false "
+                "intra_op_parallelism_threads=1").strip()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        if not args.concurrency:  # 0 from sizing: drivers match cores
+            args.concurrency = min(8, os.cpu_count() or 2)
+        return run_quantize_mode(args)
 
     if args.artifact:
         source = load(args.artifact)

@@ -13,6 +13,12 @@
 #                                # files; combine with --all for full-tree
 #   scripts/lint.sh FILES...     # explicit file list
 #
+# The dtype-flow rules (G017-G021) treat the quantized-serving modules —
+# serving/engine.py (dequant-free scorers) and io/checkpoint.py (quant
+# pack/unpack helpers) — as ALWAYS hot (analysis/config.py
+# DTYPEFLOW_HOT_MODULES), so every gating scan here prices a widened
+# full-table dequant or a silent promotion in the quant plumbing.
+#
 # Exits non-zero on any finding not covered by analysis/baseline.json.
 # Accepted debt is refreshed with:
 #   python -m hivemall_tpu.analysis --update-baseline

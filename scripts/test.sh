@@ -31,4 +31,12 @@ bash scripts/lint.sh --fix-check
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --smoke
 
+# tier-1 gate 4: quantized-serving smoke — one tiny model frozen f32/bf16/
+# int8, served through all three engines: the int8/bf16 holdout logloss
+# must sit within the parity tolerance of f32 AND every precision must
+# show zero steady-state recompiles (docs/serving.md "Quantized
+# artifacts"; prints one BENCH-style JSON line)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_serving.py --quantize --smoke
+
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

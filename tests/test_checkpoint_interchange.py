@@ -77,3 +77,68 @@ def test_npz_load_closes_file_handle(tmp_path):
     assert opened, "np.load did not open a zip?"
     assert all(z.fp is None for z in opened), \
         "NpzFile zip handle left open — wrap np.load in a context manager"
+
+
+# --- quantized at-rest helpers (freeze(quantize=...) substrate) ------------
+
+def test_bf16_pack_raw_roundtrip_is_bit_exact():
+    """bf16 tables store as raw uint16 bit patterns: np.savez can't hold
+    ml_dtypes, but a view can — unpack must return the EXACT bits, and
+    packing an f32 input must equal rounding it to bf16 first."""
+    import jax.numpy as jnp
+
+    from hivemall_tpu.io.checkpoint import bf16_pack_raw, bf16_unpack_raw
+
+    rng = np.random.RandomState(3)
+    f32 = rng.randn(64, 3).astype(np.float32)
+    bf16 = np.asarray(f32).astype(jnp.bfloat16)
+    packed = bf16_pack_raw(bf16)
+    assert packed.dtype == np.uint16
+    back = bf16_unpack_raw(packed)
+    assert back.dtype == jnp.bfloat16
+    assert np.array_equal(back.view(np.uint16), bf16.view(np.uint16))
+    # f32 input: the rounding to bf16 IS the quantization
+    assert np.array_equal(bf16_pack_raw(f32), packed)
+
+
+def test_quantize_int8_roundtrip_within_half_scale():
+    from hivemall_tpu.io.checkpoint import dequantize_int8, quantize_int8
+
+    rng = np.random.RandomState(7)
+    table = rng.randn(200, 4).astype(np.float32)  # 200 rows: tail block
+    q, scales = quantize_int8(table, block_rows=64)
+    assert q.dtype == np.int8 and q.shape == table.shape
+    assert scales.dtype == np.float32
+    assert scales.shape == (4, 4)  # ceil(200/64) blocks
+    deq = dequantize_int8(q, scales, block_rows=64)
+    # symmetric absmax: every value within half a step of its block scale
+    per_row_scale = np.repeat(scales, 64, axis=0)[:200]
+    assert np.all(np.abs(deq - table) <= per_row_scale * 0.5 + 1e-7)
+
+
+def test_quantize_int8_all_zero_block_and_tail():
+    """Edge cases the serving gather must survive: an all-zero block
+    records scale 1.0 (q == 0 dequantizes to exact zero, no 0/0), and a
+    single-row tail block quantizes against its own absmax — the zero
+    padding used for the reshape never leaks into scales or q."""
+    from hivemall_tpu.io.checkpoint import dequantize_int8, quantize_int8
+
+    table = np.zeros((65, 2), np.float32)  # 64-row zero block + 1-row tail
+    table[64] = [3.0, -1.5]
+    q, scales = quantize_int8(table, block_rows=64)
+    assert np.all(q[:64] == 0)
+    assert np.all(scales[0] == 1.0)  # all-zero block: scale 1.0, not 0/NaN
+    deq = dequantize_int8(q, scales, block_rows=64)
+    assert np.array_equal(deq[:64], np.zeros((64, 2), np.float32))
+    # tail block absmax comes from the real row, not the pad
+    assert np.allclose(deq[64], table[64], atol=3.0 / 127 * 0.5 + 1e-7)
+    assert np.allclose(scales[1], np.abs(table[64]) / 127.0)
+
+
+def test_quantize_int8_rejects_non_power_of_two_blocks():
+    import pytest
+
+    from hivemall_tpu.io.checkpoint import quantize_int8
+
+    with pytest.raises(ValueError, match="power of two"):
+        quantize_int8(np.ones((8, 2), np.float32), block_rows=48)

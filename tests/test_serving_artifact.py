@@ -217,3 +217,127 @@ def test_tree_serving_keeps_f64_when_quantitative_edges_collapse():
     sv = _TreeServable([], [BinInfo(False, edges, len(edges))])
     assert sv.stage_dtype == np.float64
     assert sv.bins[0].edges.dtype == np.float64  # edges NOT narrowed
+
+
+# --- quantized artifacts (freeze(quantize="bf16"|"int8")) ------------------
+
+def _quant_roundtrip(model, instances, ref, tmp_path, tag, tol):
+    """Freeze at bf16 + int8, serve both, pin the manifest schema and that
+    quantized scores sit within ``tol`` of the f32 reference — plus that
+    the resident score tables actually shrink (disk bytes are pinned by the
+    bench at real scale, where npz overhead stops dominating)."""
+    from hivemall_tpu.serving.artifact import manifest_quant, rebuild_model
+
+    f32_path = str(tmp_path / f"{tag}_f32")
+    freeze(model, f32_path, name=tag, version="1")
+    f32_eng = ServingEngine(load(f32_path), name=f"q_{tag}_f32",
+                            max_batch=16, max_width=16)
+    ref = np.asarray(ref, np.float64)
+    for q, scheme in (("bf16", "bf16"), ("int8", "int8_absmax")):
+        path = str(tmp_path / f"{tag}_{q}")
+        man = freeze(model, path, name=tag, version="1", quantize=q)
+        quant = manifest_quant(man["meta"])
+        assert quant["scheme"] == scheme
+        assert quant["tables"], f"{tag}/{q}: no quantized tables recorded"
+        if q == "int8":
+            assert quant["block_rows"] > 0
+            assert man["meta"]["weights_dtype"] == "int8"
+        else:
+            assert man["meta"]["weights_dtype"] == "bfloat16"
+        art = load(path)  # sha256-verified like any artifact
+        with pytest.raises(ValueError, match="quantized"):
+            rebuild_model(art)  # serving-only: no full-precision rebuild
+        eng = ServingEngine(art, name=f"q_{tag}_{q}", max_batch=16,
+                            max_width=16)
+        assert eng.weights_dtype == man["meta"]["weights_dtype"]
+        served = np.asarray(eng.predict(instances), np.float64)
+        assert np.max(np.abs(served - ref)) <= tol, \
+            f"{tag}/{q}: quantized scores drifted past {tol}"
+        assert 0 < eng.table_bytes < f32_eng.table_bytes, \
+            f"{tag}/{q}: resident score tables did not shrink"
+
+
+def test_quantized_linear_roundtrip(tmp_path):
+    from hivemall_tpu.models.classifier import train_arow
+
+    m = train_arow(ROWS, LABELS, "-dims 256")
+    _quant_roundtrip(m, ROWS, m.predict(ROWS), tmp_path, "qlin", tol=0.02)
+
+
+def test_quantized_multiclass_roundtrip(tmp_path):
+    """Labels (not margins) are the served surface: pin full agreement
+    with the f32 argmax on well-separated training rows."""
+    from hivemall_tpu.models.multiclass import train_multiclass_pa
+
+    labels = ["a", "b", "c"] * 10
+    m = train_multiclass_pa(ROWS, labels, "-dims 128")
+    ref = m.predict(ROWS)
+    for q in ("bf16", "int8"):
+        path = str(tmp_path / f"qmc_{q}")
+        freeze(m, path, name="qmc", version="1", quantize=q)
+        eng = ServingEngine(load(path), name=f"qmc_{q}", max_batch=16,
+                            max_width=16)
+        assert list(eng.predict(ROWS)) == list(ref)
+
+
+def test_quantized_fm_roundtrip(tmp_path):
+    from hivemall_tpu.models.fm import train_fm
+
+    m = train_fm(ROWS, [float(v) for v in LABELS], "-p 128 -factor 3")
+    _quant_roundtrip(m, ROWS, m.predict(ROWS), tmp_path, "qfm", tol=0.02)
+
+
+def test_quantized_mf_roundtrip(tmp_path):
+    from hivemall_tpu.models.mf import train_mf_sgd
+
+    users = [i % 5 for i in range(40)]
+    items = [(i * 3) % 7 for i in range(40)]
+    m = train_mf_sgd(users, items, [float((i % 5) + 1) for i in range(40)])
+    pairs = list(zip(users[:10], items[:10]))
+    _quant_roundtrip(m, pairs, m.predict(users[:10], items[:10]), tmp_path,
+                     "qmf", tol=0.05)
+
+
+def test_quantize_refuses_families_without_weight_tables(tmp_path):
+    """Trees walk int32 structure and FFM rides an opaque codec blob —
+    freeze(quantize=...) must refuse loudly, not silently no-op."""
+    from hivemall_tpu.models.trees.forest import train_randomforest_classifier
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(60, 4)
+    y = (X[:, 0] + X[:, 1] > 1).astype(int)
+    m = train_randomforest_classifier(X, y, "-trees 3 -seed 1")
+    with pytest.raises(ValueError, match="no quantized serving path"):
+        freeze(m, str(tmp_path / "qforest"), quantize="bf16")
+
+
+def test_quantize_argument_validation(tmp_path):
+    from hivemall_tpu.models.classifier import train_perceptron
+
+    m = train_perceptron(ROWS, LABELS, "-dims 128")
+    with pytest.raises(ValueError, match="bf16.*int8|int8.*bf16"):
+        freeze(m, str(tmp_path / "v1"), quantize="fp4")
+    with pytest.raises(ValueError, match="quant_block_rows"):
+        freeze(m, str(tmp_path / "v2"), quant_block_rows=64)
+    with pytest.raises(ValueError, match="power of two"):
+        freeze(m, str(tmp_path / "v3"), quantize="int8", quant_block_rows=48)
+
+
+def test_quantized_int8_custom_block_rows_roundtrip(tmp_path):
+    """A non-default power-of-two block size lands in the manifest and the
+    serve-side block_shift folds the right scale per gathered id (dims 100
+    with block 32 exercises the tail block on the real linear path)."""
+    from hivemall_tpu.models.classifier import train_arow
+    from hivemall_tpu.serving.artifact import manifest_quant
+
+    rows = [[f"{i % 97}:1.0", f"{(i * 7) % 97}:0.5"] for i in range(30)]
+    m = train_arow(rows, LABELS, "-dims 100")
+    path = str(tmp_path / "qblk")
+    man = freeze(m, path, name="qblk", version="1", quantize="int8",
+                 quant_block_rows=32)
+    assert manifest_quant(man["meta"])["block_rows"] == 32
+    eng = ServingEngine(load(path), name="qblk32", max_batch=16,
+                        max_width=16)
+    served = np.asarray(eng.predict(rows), np.float64)
+    ref = np.asarray(m.predict(rows), np.float64)
+    assert np.max(np.abs(served - ref)) <= 0.02

@@ -88,3 +88,101 @@ def test_padding_rows_do_not_leak_into_results(model):
     one = eng.predict(ROWS[:1])
     many = eng.predict(ROWS[:32])
     assert np.asarray(one)[0] == np.asarray(many)[0]
+
+
+def test_quantized_serving_zero_recompiles(model, tmp_path):
+    """The f32 zero-steady-state-recompile pin, mirrored over the quantized
+    artifacts: bf16 (families' own scorers at bf16) and int8 (the shared
+    _q8_* dequant-free scorers) must warm every bucket once and then sweep
+    every bucket combination without a single recompile — the whole point
+    of folding the scale into the dot product instead of branching on
+    precision at request time."""
+    from hivemall_tpu.serving import freeze, load
+
+    for q in ("bf16", "int8"):
+        path = str(tmp_path / q)
+        freeze(model, path, name=f"qsweep_{q}", version="1", quantize=q)
+        eng = ServingEngine(load(path), name=f"qsweep_{q}", max_batch=32,
+                            max_width=16)
+        eng.warmup()
+        assert len(eng.warmed_buckets) == \
+            len(eng.batch_buckets()) * len(eng.width_buckets())
+        assert eng.warmup() == 0  # second warmup: everything compiled
+
+        counter = REGISTRY.counter("graftcheck",
+                                   f"recompiles.serving.qsweep_{q}")
+        before = counter.value
+        with recompile_guard(f"qsweep_{q}_sweep", *eng.servable.jit_fns,
+                             expect_stable=True):
+            for n in (1, 7, 8, 9, 16, 30, 32):
+                for width in (1, 5, 8, 13, 16):
+                    batch = [[f"{k % 13}:1.0" for k in range(width)]
+                             for _ in range(n)]
+                    out = eng.predict(batch)
+                    assert len(out) == n
+        assert counter.value == before, \
+            f"{q}: steady-state quantized serving recompiled"
+
+
+def test_warmup_dummy_construction_is_deduped():
+    """Warmup dedup satellite: dummy instances are keyed by bucket shape
+    and shared — warming a second same-family engine over the same bucket
+    mesh must not re-construct a single dummy row."""
+    from hivemall_tpu.serving import engine as eng_mod
+
+    m = train_arow(ROWS, LABELS, "-dims 256")
+    e1 = ServingEngine(m, name="dedup_a", max_batch=32, max_width=16)
+    e1.warmup()
+    sv = e1.servable
+    calls = []
+    orig = type(sv).dummy_instance
+
+    def spy(self, width):
+        calls.append(width)
+        return orig(self, width)
+
+    type(sv).dummy_instance = spy
+    try:
+        e2 = ServingEngine(m, name="dedup_b", max_batch=32, max_width=16)
+        e2.warmup()
+    finally:
+        type(sv).dummy_instance = orig
+    assert calls == [], \
+        f"second engine re-constructed warmup dummies for widths {calls}"
+    # and the second engine still warmed its full bucket mesh
+    assert len(e2.warmed_buckets) == \
+        len(e2.batch_buckets()) * len(e2.width_buckets())
+
+
+def test_preparsed_requests_match_string_requests(model):
+    """The pre-parsed (idx_rows, val_rows) request path — vectorized
+    staging, no per-row Python loop — must score bit-identically to the
+    same rows as strings, including empty rows, overwide truncation, and
+    id hashing (mod dims)."""
+    from hivemall_tpu.models.base import _stage_rows
+
+    eng = ServingEngine(model, name="eng_preparsed", max_batch=16,
+                        max_width=8)
+    rows = [["1:1.0", "260:0.5"],  # 260 % 256 == 4: hashing applies
+            [],
+            [f"{k}:0.25" for k in range(12)],  # overwide: truncates at 8
+            ["7:2.0"]]
+    ref = np.asarray(eng.predict(rows))
+    pre = _stage_rows(rows, eng.servable.dims)
+    out = np.asarray(eng.predict(pre))
+    assert np.array_equal(out, ref)
+
+    # the flat packed 3-tuple form scores identically as well
+    lens = np.array([len(r) for r in pre[0]], np.int64)
+    flat = (np.concatenate(pre[0]), np.concatenate(pre[1]), lens)
+    assert np.array_equal(np.asarray(eng.predict(flat)), ref)
+
+    # chunking across max_batch keeps both tuple paths consistent
+    many = rows * 13  # 52 rows > max_batch
+    ref_many = np.asarray(eng.predict(many))
+    pre_many = _stage_rows(many, eng.servable.dims)
+    assert np.array_equal(np.asarray(eng.predict(pre_many)), ref_many)
+    lens_many = np.array([len(r) for r in pre_many[0]], np.int64)
+    flat_many = (np.concatenate(pre_many[0]), np.concatenate(pre_many[1]),
+                 lens_many)
+    assert np.array_equal(np.asarray(eng.predict(flat_many)), ref_many)
