@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
 
 
 def translate_to_stripe(idx, val, shard_axis: str, stripe: int):
@@ -35,3 +37,64 @@ def translate_to_stripe(idx, val, shard_axis: str, stripe: int):
     owned = (local_idx >= 0) & (local_idx < stripe)
     local_idx = jnp.where(owned, local_idx, stripe)
     return local_idx, val * owned.astype(val.dtype)
+
+
+def restripe_array(arr, axis: int, dims: int, dims_padded: int, fill=0.0):
+    """Move ONE striped table axis between stripe grids: unpad at the old
+    grid (slice back to the logical ``dims``), re-pad at the new grid
+    (``dims_padded = stripe' * M``) with ``fill``. The unpad is safe by the
+    engine's padding protocol (parallel/sharded_train.py module doc): no
+    data id ever reaches a slot past ``dims``, so slicing them off loses
+    nothing; the re-pad fill must match the family's init value for the
+    slot (weights 0, covariances 1 — a zero-padded covariance puts inf/NaN
+    in the argminKLD mix's 1/cov reads)."""
+    a = np.asarray(arr)
+    if a.shape[axis] < dims:
+        raise ValueError(
+            f"striped axis {axis} has {a.shape[axis]} < dims {dims}")
+    if a.shape[axis] > dims:
+        sl = [slice(None)] * a.ndim
+        sl[axis] = slice(0, dims)
+        a = a[tuple(sl)]
+    if dims_padded > dims:
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, dims_padded - dims)
+        a = np.pad(a, widths, constant_values=fill)
+    return a
+
+
+def restripe(host, specs, mesh, axis_name: str, dims: int, dims_padded: int,
+             fills: dict | None = None):
+    """Re-stripe a COLLAPSED host pytree onto the CURRENT mesh — the
+    elastic-resume N→M placement: every leaf whose PartitionSpec stripes
+    ``axis_name`` runs restripe_array over that axis (unpad the old grid,
+    re-pad to ``dims_padded``, the new mesh's ``stripe' * M``), then every
+    leaf — striped or replicated — device_puts with its
+    ``NamedSharding(mesh, spec)``. The striped axis is read from each
+    leaf's spec, never guessed from sizes (same discipline as the
+    trainers' _unpad_state).
+
+    ``fills`` maps a leaf's field name (the last attribute/dict key on its
+    tree path, e.g. ``"covars"``) to its re-pad fill; unnamed leaves pad
+    with 0."""
+    fills = fills or {}
+
+    def leaf_fill(path) -> float:
+        for key in reversed(path):
+            name = getattr(key, "name", None)
+            if name is None:
+                name = getattr(key, "key", None)
+            if isinstance(name, str):
+                return fills.get(name, 0.0)
+        return 0.0
+
+    def place(path, leaf, spec):
+        a = np.asarray(jax.device_get(leaf))
+        for ax, name in enumerate(tuple(spec)):
+            if name == axis_name:
+                a = restripe_array(a, ax, dims, dims_padded,
+                                   fill=leaf_fill(path))
+                break
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, host, specs)

@@ -17,9 +17,11 @@ Two tiers here:
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Optional, Tuple
+import warnings
+from typing import Dict, Mapping, Optional, Tuple
 
 import jax
 import numpy as np
@@ -198,7 +200,9 @@ def dequantize_int8(q, scales, block_rows: int = QUANT_BLOCK_ROWS,
     return np.moveaxis(qq.astype(np.float32) * per_row, 0, axis)
 
 
-def save_linear_state(path: str, state: LinearState) -> None:
+def pack_linear_state(state: LinearState) -> Dict[str, np.ndarray]:
+    """LinearState -> the npz array payload (one copy of the layout, shared
+    by save_linear_state and the elastic-checkpoint writer)."""
     host = jax.device_get(state)
     arrays = {
         "weights": np_saveable(host.weights),
@@ -214,31 +218,171 @@ def save_linear_state(path: str, state: LinearState) -> None:
         arrays[f"slot__{k}"] = np.asarray(v)
     for k, v in host.globals.items():
         arrays[f"global__{k}"] = np.asarray(v)
-    np.savez_compressed(path, **arrays)
+    return arrays
+
+
+def unpack_linear_state(arrays: Mapping[str, np.ndarray]) -> LinearState:
+    """The load half of pack_linear_state, over any name->array mapping
+    (an open NpzFile or the dict load_elastic returns)."""
+    import jax.numpy as jnp
+
+    # dtype pins (graftcheck G020): weights/covars re-narrow to their
+    # recorded training dtype; slots/globals/touched/step are f32 /
+    # int8 / int32 by construction (core/state.init_linear_state)
+    wdt = str(arrays["weights_dtype"][()]) if "weights_dtype" in arrays \
+        else None
+    table_dt = dtype_from_name(wdt)
+    slots = {k[len("slot__"):]: jnp.asarray(arrays[k], jnp.float32)
+             for k in arrays if k.startswith("slot__")}
+    globals_ = {k[len("global__"):]: jnp.asarray(arrays[k], jnp.float32)
+                for k in arrays if k.startswith("global__")}
+    return LinearState(
+        weights=jnp.asarray(arrays["weights"], table_dt),
+        covars=jnp.asarray(arrays["covars"], table_dt)
+        if "covars" in arrays else None,
+        slots=slots,
+        touched=jnp.asarray(arrays["touched"], jnp.int8),
+        step=jnp.asarray(arrays["step"], jnp.int32),
+        globals=globals_,
+    )
+
+
+def save_linear_state(path: str, state: LinearState) -> None:
+    np.savez_compressed(path, **pack_linear_state(state))
 
 
 def load_linear_state(path: str) -> LinearState:
-    import jax.numpy as jnp
-
     # all arrays materialize inside the with: NpzFile reads lazily from the
     # underlying zip and must be closed (fd leak otherwise)
     with np.load(path) as z:
-        # dtype pins (graftcheck G020): weights/covars re-narrow to their
-        # recorded training dtype; slots/globals/touched/step are f32 /
-        # int8 / int32 by construction (core/state.init_linear_state)
-        wdt = str(z["weights_dtype"][()]) if "weights_dtype" in z.files \
-            else None
-        table_dt = dtype_from_name(wdt)
-        slots = {k[len("slot__"):]: jnp.asarray(z[k], jnp.float32)
-                 for k in z.files if k.startswith("slot__")}
-        globals_ = {k[len("global__"):]: jnp.asarray(z[k], jnp.float32)
-                    for k in z.files if k.startswith("global__")}
-        return LinearState(
-            weights=jnp.asarray(z["weights"], table_dt),
-            covars=jnp.asarray(z["covars"], table_dt)
-            if "covars" in z.files else None,
-            slots=slots,
-            touched=jnp.asarray(z["touched"], jnp.int8),
-            step=jnp.asarray(z["step"], jnp.int32),
-            globals=globals_,
-        )
+        return unpack_linear_state({k: z[k] for k in z.files})
+
+
+# --- elastic checkpoints (runtime/recovery checkpoint()/elastic_resume()) ---
+# One self-contained npz per checkpoint: the COLLAPSED, stripe-free payload
+# arrays plus an embedded JSON manifest recording striping metadata (dims,
+# dims_padded, n_shards, stripe, rule/hyper, step) and a sha256 digest over
+# the payload bytes. Self-contained means single-file atomic: write tmp,
+# rotate the previous checkpoint to `path.prev`, rename tmp into place — a
+# crash at ANY point leaves at least one valid checkpoint on disk, and the
+# loader verifies the digest and falls back (loudly) to `.prev` when the
+# newest file is truncated or corrupt.
+
+ELASTIC_FORMAT_VERSION = 1
+MANIFEST_KEY = "__manifest__"
+PREV_SUFFIX = ".prev"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """The checkpoint file exists but cannot be trusted: unreadable zip
+    (truncation), missing manifest, or payload digest mismatch."""
+
+
+class NotElasticCheckpoint(CheckpointCorrupt):
+    """A readable npz with no embedded manifest — a legacy
+    save_linear_state checkpoint, not a rotted elastic one. The resume
+    path treats it as the pre-manifest format instead of falling back."""
+
+
+def elastic_digest(arrays: Mapping[str, np.ndarray]) -> str:
+    """sha256 over the payload: sorted (name, dtype, shape, raw bytes).
+    The manifest carries this digest, so it cannot cover itself — the
+    loader recomputes over the arrays and compares."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        if name == MANIFEST_KEY:
+            continue
+        a = np.ascontiguousarray(np.asarray(arrays[name]))
+        h.update(name.encode())
+        h.update(str(a.dtype.str).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def crash_point(tag: str, path: str) -> None:
+    """No-op hook on the checkpoint write path — the monkeypatch target the
+    fault harness (runtime/faults.py) uses to simulate a crash between the
+    payload write and the atomic rename. Tags: ``elastic.after_write`` (tmp
+    exists, nothing rotated), ``elastic.before_rename`` (previous checkpoint
+    already rotated to .prev, new one not yet in place)."""
+
+
+def checkpoint_written(path: str) -> None:
+    """No-op hook fired after a successful write+rename — the fault
+    harness's seat for post-hoc truncation/corruption injection."""
+
+
+def save_elastic(path: str, arrays: Dict[str, np.ndarray],
+                 manifest: dict) -> dict:
+    """Atomically persist an elastic checkpoint: payload ``arrays`` plus
+    ``manifest`` (digest and format_version are stamped here). On success
+    the previous checkpoint survives as ``path + '.prev'`` — the loader's
+    fallback when a later write is interrupted or the newest file rots.
+    Returns the stamped manifest."""
+    manifest = dict(manifest)
+    manifest["format_version"] = ELASTIC_FORMAT_VERSION
+    manifest["digest"] = elastic_digest(arrays)
+    # .npz suffix keeps np.savez from renaming the temp file under us
+    tmp = path + ".tmp.npz"
+    np.savez_compressed(
+        tmp, **arrays,
+        **{MANIFEST_KEY: np.asarray(json.dumps(manifest))})
+    crash_point("elastic.after_write", path)
+    if os.path.exists(path):
+        os.replace(path, path + PREV_SUFFIX)
+    crash_point("elastic.before_rename", path)
+    os.replace(tmp, path)
+    checkpoint_written(path)
+    return manifest
+
+
+def _load_elastic_one(path: str):
+    """Read + verify ONE checkpoint file. Raises CheckpointCorrupt on any
+    integrity failure (truncated zip, missing/unparsable manifest, digest
+    mismatch) and FileNotFoundError when absent."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            arrays = {k: z[k] for k in z.files}
+    except FileNotFoundError:
+        raise
+    except Exception as e:  # zipfile.BadZipFile, zlib.error, ValueError ...
+        raise CheckpointCorrupt(f"{path}: unreadable npz ({e})") from e
+    if MANIFEST_KEY not in arrays:
+        raise NotElasticCheckpoint(
+            f"{path}: no {MANIFEST_KEY} entry — not an elastic checkpoint")
+    try:
+        manifest = json.loads(str(arrays.pop(MANIFEST_KEY)[()]))
+    except Exception as e:
+        raise CheckpointCorrupt(f"{path}: unparsable manifest ({e})") from e
+    digest = elastic_digest(arrays)
+    if digest != manifest.get("digest"):
+        raise CheckpointCorrupt(
+            f"{path}: payload digest {digest[:12]}… does not match the "
+            f"manifest's {str(manifest.get('digest'))[:12]}…")
+    return arrays, manifest
+
+
+def load_elastic(path: str, fallback: bool = True):
+    """Load + verify the newest valid checkpoint at ``path``. When the
+    newest file is missing or corrupt and ``fallback`` is on, fall back —
+    loudly, with a warning naming the reason — to ``path + '.prev'`` (the
+    last successfully-rotated checkpoint) instead of crashing the resume.
+    Returns ``(arrays, manifest)``."""
+    try:
+        return _load_elastic_one(path)
+    except (FileNotFoundError, CheckpointCorrupt) as e:
+        if not fallback or isinstance(e, NotElasticCheckpoint):
+            # a legacy (pre-manifest) checkpoint is a format, not a rot —
+            # the caller decides how to read it
+            raise
+        prev = path + PREV_SUFFIX
+        if not os.path.exists(prev):
+            raise
+        warnings.warn(
+            f"elastic checkpoint {path} is unusable ({e}); falling back to "
+            f"the previous checkpoint {prev} — work since that checkpoint "
+            "will be replayed", RuntimeWarning, stacklevel=2)
+        return _load_elastic_one(prev)

@@ -52,9 +52,11 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.engine import DELTA_SLOT, Rule, make_train_fn
 from ..core.state import LinearState, init_linear_state
+from ..core.striping import restripe
 from .mesh import SHARD_AXIS, WORKER_AXIS, make_mesh, make_mesh_2d
-from .mix import (MixConfig, collapse_linear_replicas, grouped_mix_scan,
-                  make_linear_mix, replicate_state, split_replica_blocks)
+from .mix import (MixConfig, add_replica_base, collapse_linear_replicas,
+                  grouped_mix_scan, make_linear_mix, replicate_state,
+                  split_replica_blocks, strip_replica_base)
 from .sharded import stripe_score
 from ..runtime.jax_compat import shard_map
 from ..runtime.tracing import TRACER
@@ -96,6 +98,32 @@ def _unpad_state(host, dims: int, dims_padded: int, specs, axis_name: str):
         return x
 
     return jax.tree.map(unpad, host, specs)
+
+
+def _align_linear_host(host: LinearState, dims: int, use_covariance: bool,
+                       slot_names: tuple, global_names: tuple) -> LinearState:
+    """Normalize a checkpointed host LinearState to THIS trainer's field
+    structure before re-striping: slots/globals the rule expects but the
+    checkpoint lacks fill with zeros (e.g. the 2-D trainer's mix delta
+    counter resuming from a plain sharded checkpoint); extras drop; a
+    covariance learner resuming a covariance-free checkpoint starts its
+    covariance at the init value 1.0. This is what makes resume
+    cross-family: any collapsed linear checkpoint seeds any linear
+    trainer."""
+    host = jax.device_get(host)
+    slots = dict(host.slots or {})
+    covars = host.covars
+    if use_covariance and covars is None:
+        covars = np.ones(dims, np.asarray(host.weights).dtype)
+    elif not use_covariance:
+        covars = None
+    return host.replace(
+        covars=covars,
+        slots={name: np.asarray(slots[name]) if name in slots
+               else np.zeros(dims, np.float32) for name in slot_names},
+        globals={name: np.asarray((host.globals or {}).get(name, 0.0),
+                                  np.float32) for name in global_names},
+    )
 
 
 def _pad_initial(arr, dims_padded, fill=0.0):
@@ -166,12 +194,30 @@ class ShardedTrainer:
             **kwargs,
         )
 
-    def init(self, **kwargs) -> LinearState:
+    def init(self, from_state: Optional[LinearState] = None,
+             **kwargs) -> LinearState:
         """Initial state with [D] leaves placed feature-sharded on the mesh —
         each device allocates only its stripe. kwargs pass through to
         init_linear_state (initial_weights/initial_covars = -loadmodel warm
         start, ref: LearnerBaseUDTF.java:215-333); [dims] arrays pad up to
-        the sharded table size."""
+        the sharded table size.
+
+        ``from_state`` is the elastic-resume path: a COLLAPSED host
+        LinearState (a final_state() / checkpoint load) re-stripes onto
+        THIS mesh through core.striping.restripe — unpad at the old grid,
+        re-pad at this mesh's ``stripe * n``, place with NamedSharding —
+        so a run checkpointed under N devices resumes under M≠N with the
+        full optimizer state (slots, step, Welford globals) intact."""
+        if from_state is not None:
+            if kwargs:
+                raise ValueError("pass either from_state or init kwargs")
+            host = _align_linear_host(from_state, self.dims,
+                                      self.rule.use_covariance,
+                                      tuple(self.rule.slot_names),
+                                      tuple(self.rule.global_names))
+            return restripe(host, self._specs, self.mesh, self.axis,
+                            self.dims, self.dims_padded,
+                            fills={"covars": 1.0})
         if not kwargs:
             return _born_sharded(self._init_one, self.mesh, self._specs)
         for key, fill in (("initial_weights", 0.0), ("initial_covars", 1.0)):
@@ -261,8 +307,18 @@ class FMShardedTrainer:
             donate_argnums=(0,),
         )
 
-    def init(self):
-        return _born_sharded(self._init_fn, self.mesh, self._specs)
+    def init(self, from_state=None):
+        """Default: born sharded (fresh V draw at the padded shape). With
+        ``from_state`` — a collapsed host FMState from final_state() or an
+        elastic checkpoint — every table re-stripes onto THIS mesh
+        (core.striping.restripe): w/touched unpad+re-pad along dim 0, the
+        [D, k] V table re-pads its row axis (pad rows are never gathered —
+        no data id reaches a slot past dims — so zero-fill is exact), and
+        scalars replicate. A 4-device run resumes on 2 or 8."""
+        if from_state is None:
+            return _born_sharded(self._init_fn, self.mesh, self._specs)
+        return restripe(from_state, self._specs, self.mesh, self.axis,
+                        self.dims, self.dims_padded)
 
     def step(self, state, indices, values, labels, va=None):
         """indices/values: [B, K]; labels: [B] (replicated)."""
@@ -588,6 +644,7 @@ class Sharded2DTrainer:
         self.config = config
         self.stripe = -(-dims // self.n_shards)
         self.dims_padded = self.stripe * self.n_shards
+        self._resume_base = None  # set by init(from_state=...) on warm restart
         reduction = config.reduction
         if reduction == "auto":
             reduction = "argmin_kld" if rule.use_covariance else "average"
@@ -642,10 +699,41 @@ class Sharded2DTrainer:
             **kwargs,
         )
 
-    def init(self, **kwargs) -> LinearState:
+    def init(self, from_state: Optional[LinearState] = None,
+             **kwargs) -> LinearState:
         """Replicated-then-striped initial state: every leaf gains a leading
         [R] replica axis; [D] leaves additionally shard into [D/S] stripes —
-        each device allocates [1, stripe]."""
+        each device allocates [1, stripe].
+
+        ``from_state`` seeds every replica from a collapsed checkpoint (the
+        elastic-restart path over BOTH mesh axes at once: the table
+        re-stripes to this mesh's stripe grid AND re-replicates to its
+        replica count). Exactly like MixTrainer, the seeded base is
+        remembered so final_state() strips it from each replica's ADDITIVE
+        statistics (step, sum-kind slots, Welford globals) before the
+        collapse and restores it once after — nothing is counted
+        n_replicas times, no matter how many checkpoint/resume cycles
+        stack."""
+        self._resume_base = None
+        if from_state is not None:
+            if kwargs:
+                raise ValueError("pass either from_state or init kwargs")
+            host = _align_linear_host(
+                from_state, self.dims, self.rule.use_covariance,
+                tuple(self.rule.slot_names) + (DELTA_SLOT,),
+                tuple(self.rule.global_names))
+            dp = self.dims_padded
+            padded = host.replace(
+                weights=_pad_initial(np.asarray(host.weights), dp),
+                covars=_pad_initial(np.asarray(host.covars), dp, 1.0)
+                if host.covars is not None else None,
+                slots={k: _pad_initial(np.asarray(v), dp)
+                       for k, v in host.slots.items()},
+                touched=_pad_initial(np.asarray(host.touched), dp),
+            )
+            self._resume_base = padded
+            return replicate_state(padded, self.n_replicas, self.mesh,
+                                   specs=self._specs, axis=self.replica_axis)
         for key, fill in (("initial_weights", 0.0), ("initial_covars", 1.0)):
             if kwargs.get(key) is not None:
                 kwargs[key] = _pad_initial(kwargs[key], self.dims_padded, fill)
@@ -670,10 +758,19 @@ class Sharded2DTrainer:
     def final_state(self, state: LinearState) -> LinearState:
         """Collapse the replica axis (collapse_linear_replicas: trailing-mix
         weights, touched union, slot merge, Welford merge) and slice the
-        padding back off, returning a plain [dims] model."""
+        padding back off, returning a plain [dims] model. A warm-started
+        run (init(from_state=...)) strips the seeded base from each
+        replica's additive statistics before the merge and restores it
+        once after — see strip_replica_base/add_replica_base."""
         with TRACER.span("train.sync", args={"trainer": "sharded_2d"}):
             host = jax.device_get(state)
-        merged = collapse_linear_replicas(host, dict(self.rule.slot_merge))
+        kinds = dict(self.rule.slot_merge)
+        base = self._resume_base
+        if base is not None:
+            host = strip_replica_base(host, base, kinds)
+        merged = collapse_linear_replicas(host, kinds)
+        if base is not None:
+            merged = add_replica_base(merged, base, kinds)
         # collapsed leaves lost the leading replica axis: strip it from the
         # specs too, then slice the stripe axis they name
         collapsed_specs = jax.tree.map(lambda s: P(*tuple(s)[1:]), self._specs)

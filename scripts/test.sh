@@ -39,4 +39,11 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --quantize --smoke
 
+# tier-1 gate 5: chaos smoke — a seeded device loss mid-run must end in an
+# elastic resume on a DIFFERENT simulated device count that converges to
+# the uninterrupted run's holdout logloss within tolerance with zero lost
+# checkpointed work (docs/elastic_training.md; one BENCH-style JSON line)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_chaos.py --smoke
+
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

@@ -1,0 +1,406 @@
+"""Seeded fault injection + elastic recovery (runtime/faults.py,
+runtime/recovery.run_elastic, io/checkpoint elastic format).
+
+The robustness matrix ISSUE 8 demands, each ending in a successful
+resume with zero work lost since the last checkpoint: a device vanishing
+mid-run (the job dies, the driver rebuilds the mesh over the survivors),
+a crash between the checkpoint write and its atomic rename (the prior
+checkpoint must survive byte-intact), and checkpoint rot — truncation or
+a flipped byte — which the loader must reject by digest and fall back
+from, loudly, to ``.prev``. Every fault comes from a seeded plan, so a
+failing scenario replays bit-for-bit."""
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+DIMS = 131  # deliberately not divisible by any simulated mesh size
+
+
+def _blk(i, w_true, B=16, K=8):
+    r = np.random.RandomState(1000 + i)
+    idx = r.randint(0, DIMS, size=(B, K)).astype(np.int32)
+    val = r.rand(B, K).astype(np.float32)
+    lab = np.sign(np.sum(w_true[idx] * val, axis=-1)).astype(np.float32)
+    return idx, val, lab
+
+
+@pytest.fixture
+def w_true():
+    return np.random.RandomState(0).randn(DIMS)
+
+
+def _make_trainer_factory(path):
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.parallel.mesh import make_mesh
+    from hivemall_tpu.runtime.recovery import elastic_resume
+
+    def make_trainer(devices):
+        return elastic_resume(AROW, {"r": 0.1}, DIMS, path,
+                              mesh=make_mesh(devices=list(devices)),
+                              family="sharded")
+
+    return make_trainer
+
+
+def test_fault_plan_generation_is_seeded():
+    from hivemall_tpu.runtime.faults import FaultPlan
+
+    a = FaultPlan.generate(seed=7, n_steps=50, kinds=("device_loss",
+                                                      "corrupt"),
+                           n_faults=3, max_lost=2)
+    b = FaultPlan.generate(seed=7, n_steps=50, kinds=("device_loss",
+                                                      "corrupt"),
+                           n_faults=3, max_lost=2)
+    assert a == b
+    c = FaultPlan.generate(seed=8, n_steps=50, kinds=("device_loss",
+                                                      "corrupt"),
+                           n_faults=3, max_lost=2)
+    assert a != c
+    # write faults never land on write 1 (no .prev to fall back to yet)
+    for plan in (a, c):
+        for f in plan.faults:
+            if f.at_write is not None:
+                assert f.at_write >= 2
+
+
+def test_fault_validation():
+    from hivemall_tpu.runtime.faults import Fault
+
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        Fault("meteor_strike", at_step=1)
+    with pytest.raises(ValueError, match="needs at_step"):
+        Fault("device_loss")
+    with pytest.raises(ValueError, match="needs at_write"):
+        Fault("corrupt")
+
+
+def test_inject_refuses_to_nest_and_restores_hooks():
+    from hivemall_tpu.io import checkpoint as io_checkpoint
+    from hivemall_tpu.runtime import faults
+
+    orig_crash, orig_written = (io_checkpoint.crash_point,
+                                io_checkpoint.checkpoint_written)
+    plan = faults.FaultPlan(seed=1, faults=(
+        faults.Fault("device_loss", at_step=0),))
+    with faults.inject(plan):
+        assert io_checkpoint.crash_point is not orig_crash
+        with pytest.raises(RuntimeError, match="does not nest"):
+            with faults.inject(plan):
+                pass
+    assert io_checkpoint.crash_point is orig_crash
+    assert io_checkpoint.checkpoint_written is orig_written
+    assert faults.active() is None
+
+
+def test_run_elastic_device_loss_resumes_on_survivors(tmp_path, w_true):
+    """The headline scenario: 4 simulated devices, a seeded device loss at
+    step 6 kills the job, the driver rebuilds over 2 survivors, re-stripes
+    the checkpoint, replays the steps since, and finishes with the exact
+    per-example step count — zero mixed work lost, zero double-counted."""
+    import jax
+
+    from hivemall_tpu.runtime import faults
+    from hivemall_tpu.runtime.recovery import run_elastic
+
+    path = str(tmp_path / "ck.npz")
+    plan = faults.FaultPlan(seed=3, faults=(
+        faults.Fault("device_loss", at_step=6, n_lost=2),))
+    with faults.inject(plan) as injector:
+        trainer, state, report = run_elastic(
+            _make_trainer_factory(path),
+            lambda t, i: _blk(i, w_true), 12, path,
+            checkpoint_every=4, devices=list(jax.devices())[:4])
+    assert [f["kind"] for f in injector.fired] == ["device_loss"]
+    assert report["restarts"] == 1
+    assert report["initial_devices"] == 4
+    assert report["final_devices"] == 2
+    # the fault hit at step 6, last checkpoint at step 4: exactly 2 steps
+    # were replayed and every example still counts exactly once
+    assert report["lost_steps"] == 2
+    final = trainer.final_state(state)
+    assert int(final.step) == 12 * 16
+    # and the model actually learned through the restart
+    idx = np.random.RandomState(99).randint(0, DIMS, size=(2000, 8))
+    val = np.random.RandomState(98).rand(2000, 8).astype(np.float32)
+    y = np.sign(np.sum(w_true[idx] * val, axis=-1))
+    s = np.sum(np.asarray(final.weights)[idx] * val, axis=-1)
+    assert float(np.mean(np.sign(s) == y)) > 0.7
+
+
+def test_run_elastic_transient_error_retries_same_topology(tmp_path, w_true):
+    import jax
+
+    from hivemall_tpu.runtime import faults
+    from hivemall_tpu.runtime.recovery import run_elastic
+
+    path = str(tmp_path / "ck.npz")
+    plan = faults.FaultPlan(seed=4, faults=(
+        faults.Fault("transient_step", at_step=5),))
+    with faults.inject(plan):
+        trainer, state, report = run_elastic(
+            _make_trainer_factory(path),
+            lambda t, i: _blk(i, w_true), 8, path,
+            checkpoint_every=4, devices=list(jax.devices())[:2])
+    assert report["restarts"] == 1
+    assert report["final_devices"] == report["initial_devices"] == 2
+    assert int(trainer.final_state(state).step) == 8 * 16
+
+
+def test_run_elastic_gives_up_after_max_restarts(tmp_path, w_true):
+    import jax
+
+    from hivemall_tpu.runtime import faults
+    from hivemall_tpu.runtime.recovery import run_elastic
+
+    path = str(tmp_path / "ck.npz")
+    # unrecoverable fleet: every restart loses another device until the
+    # budget runs out
+    plan = faults.FaultPlan(seed=5, faults=tuple(
+        faults.Fault("transient_step", at_step=2) for _ in range(4)))
+    with faults.inject(plan):
+        with pytest.raises(faults.TransientStepError):
+            run_elastic(_make_trainer_factory(path),
+                        lambda t, i: _blk(i, w_true), 8, path,
+                        checkpoint_every=4, max_restarts=2,
+                        devices=list(jax.devices())[:2])
+
+
+def test_crash_mid_write_preserves_previous_checkpoint(tmp_path, w_true):
+    """Kill the writer between ``save`` and ``os.replace`` (both crash
+    windows): the prior checkpoint survives byte-valid and resume
+    proceeds from it."""
+    from hivemall_tpu.io.checkpoint import load_elastic
+    from hivemall_tpu.runtime import faults
+    from hivemall_tpu.runtime.recovery import checkpoint, elastic_resume
+
+    path = str(tmp_path / "ck.npz")
+    make = _make_trainer_factory(path)
+    import jax
+
+    trainer, state = make(list(jax.devices())[:2])
+    state, _ = trainer.step(state, *_blk(0, w_true))
+    checkpoint(trainer, state, path, block_step=1)
+    good = trainer.final_state(state)
+    good_manifest = load_elastic(path)[1]
+
+    state, _ = trainer.step(state, *_blk(1, w_true))
+    # the write counter starts when the plan arms: this is write 1
+    plan = faults.FaultPlan(seed=6, faults=(
+        faults.Fault("crash_mid_write", at_write=1),))
+    with faults.inject(plan):
+        with pytest.raises(faults.CrashMidWrite):
+            checkpoint(trainer, state, path, block_step=2)
+    # the interrupted write must not have touched the published file
+    arrays, manifest = load_elastic(path)
+    assert manifest == good_manifest
+    t2, s2 = elastic_resume(
+        trainer.rule, {"r": 0.1}, DIMS, path,
+        mesh=trainer.mesh, family="sharded")
+    np.testing.assert_array_equal(np.asarray(t2.final_state(s2).weights),
+                                  np.asarray(good.weights))
+
+
+@pytest.mark.parametrize("rot", ["corrupt", "truncate"])
+def test_rotted_checkpoint_falls_back_loudly(tmp_path, w_true, rot):
+    """A flipped byte (zip CRC / digest mismatch) or a truncation in the
+    newest checkpoint -> the loader warns and resumes from ``.prev``
+    instead of crashing the restart."""
+    import jax
+
+    from hivemall_tpu.runtime import faults
+    from hivemall_tpu.runtime.recovery import checkpoint, elastic_resume
+
+    path = str(tmp_path / "ck.npz")
+    trainer, state = _make_trainer_factory(path)(list(jax.devices())[:2])
+    state, _ = trainer.step(state, *_blk(0, w_true))
+    checkpoint(trainer, state, path, block_step=1)
+    first = trainer.final_state(state)
+
+    state, _ = trainer.step(state, *_blk(1, w_true))
+    # the write counter starts when the plan arms: this is write 1
+    plan = faults.FaultPlan(seed=7, faults=(faults.Fault(rot, at_write=1),))
+    with faults.inject(plan) as injector:
+        checkpoint(trainer, state, path, block_step=2)
+    assert [f["kind"] for f in injector.fired] == [rot]
+
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        t2, s2 = elastic_resume(trainer.rule, {"r": 0.1}, DIMS, path,
+                                mesh=trainer.mesh, family="sharded")
+    # the model that resumed is the PREVIOUS (step-1) checkpoint
+    np.testing.assert_array_equal(np.asarray(t2.final_state(s2).weights),
+                                  np.asarray(first.weights))
+
+
+def test_digest_mismatch_rejected_even_when_zip_is_valid(tmp_path):
+    """Rot that keeps the zip readable — an array rewritten wholesale —
+    still fails the manifest's sha256 and falls back. This is the case
+    zip CRCs cannot catch: a VALID npz whose content is not what the
+    manifest vouched for."""
+    from hivemall_tpu.io.checkpoint import (MANIFEST_KEY, CheckpointCorrupt,
+                                            load_elastic, save_elastic)
+
+    path = str(tmp_path / "ck.npz")
+    save_elastic(path, {"weights": np.arange(8, dtype=np.float32)},
+                 {"family": "sharded", "step": 1})
+    save_elastic(path, {"weights": np.arange(8, dtype=np.float32) * 2},
+                 {"family": "sharded", "step": 2})
+    # tamper: rewrite the newest with a modified payload but the ORIGINAL
+    # manifest (digest now vouches for bytes that are not there)
+    with np.load(path, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    manifest_raw = arrays[MANIFEST_KEY]
+    arrays["weights"] = arrays["weights"] + 1.0
+    np.savez_compressed(path, **{**arrays, MANIFEST_KEY: manifest_raw})
+
+    with pytest.raises(CheckpointCorrupt, match="digest"):
+        load_elastic(path, fallback=False)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        arrays2, manifest2 = load_elastic(path)
+    assert any("falling back" in str(w.message) for w in caught)
+    assert manifest2["step"] == 1  # the .prev (first) checkpoint
+    np.testing.assert_array_equal(arrays2["weights"],
+                                  np.arange(8, dtype=np.float32))
+
+
+def test_corrupt_elastic_over_legacy_prev_falls_back(tmp_path, w_true):
+    """The upgrade-then-rot corner: a LEGACY (pre-manifest) checkpoint got
+    rotated to ``.prev`` by the first elastic write, and that elastic
+    newest then rots. The resume must fall back — loudly — to the legacy
+    .prev, not crash re-reading the corrupt newest."""
+    import jax
+
+    from hivemall_tpu.io.checkpoint import save_linear_state
+    from hivemall_tpu.runtime.recovery import checkpoint, elastic_resume
+
+    path = str(tmp_path / "ck.npz")
+    trainer, state = _make_trainer_factory(path)(list(jax.devices())[:2])
+    state, _ = trainer.step(state, *_blk(0, w_true))
+    legacy = trainer.final_state(state)
+    save_linear_state(path, legacy)  # the pre-PR-8 format
+
+    state, _ = trainer.step(state, *_blk(1, w_true))
+    checkpoint(trainer, state, path)  # rotates the legacy file to .prev
+    with open(path, "r+b") as fh:  # ... and the elastic newest rots
+        fh.truncate(os.path.getsize(path) // 2)
+
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        t2, s2 = elastic_resume(trainer.rule, {"r": 0.1}, DIMS, path,
+                                mesh=trainer.mesh, family="sharded")
+    np.testing.assert_array_equal(np.asarray(t2.final_state(s2).weights),
+                                  np.asarray(legacy.weights))
+
+
+def test_run_elastic_warns_when_checkpoint_lacks_block_step(tmp_path,
+                                                            w_true):
+    """A checkpoint not stamped with block_step cannot position the data
+    stream: run_elastic must say so instead of silently double-applying
+    the whole stream on top of the seeded state."""
+    import jax
+
+    from hivemall_tpu.runtime.recovery import checkpoint, run_elastic
+
+    path = str(tmp_path / "ck.npz")
+    trainer, state = _make_trainer_factory(path)(list(jax.devices())[:2])
+    state, _ = trainer.step(state, *_blk(0, w_true))
+    checkpoint(trainer, state, path)  # manual loop: no block_step
+    with pytest.warns(RuntimeWarning, match="no block_step"):
+        run_elastic(_make_trainer_factory(path),
+                    lambda t, i: _blk(i, w_true), 2, path,
+                    checkpoint_every=2, devices=list(jax.devices())[:2])
+
+
+def test_first_checkpoint_corrupt_with_no_prev_is_a_hard_error(tmp_path):
+    from hivemall_tpu.io.checkpoint import (CheckpointCorrupt, load_elastic,
+                                            save_elastic)
+
+    path = str(tmp_path / "ck.npz")
+    save_elastic(path, {"weights": np.arange(4, dtype=np.float32)},
+                 {"family": "sharded"})
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(CheckpointCorrupt):
+        load_elastic(path)
+
+
+def test_corruption_offset_is_seeded(tmp_path):
+    """The same plan rots the same byte — chaos runs replay exactly."""
+    from hivemall_tpu.io.checkpoint import save_elastic
+    from hivemall_tpu.runtime import faults
+
+    offsets = []
+    for trial in range(2):
+        path = str(tmp_path / f"ck{trial}.npz")
+        plan = faults.FaultPlan(seed=11, faults=(
+            faults.Fault("corrupt", at_write=2),))
+        with faults.inject(plan) as injector:
+            save_elastic(path, {"w": np.arange(64, dtype=np.float32)}, {})
+            save_elastic(path, {"w": np.arange(64, dtype=np.float32)}, {})
+        offsets.append(injector.fired[0]["flipped_offset"])
+    assert offsets[0] == offsets[1]
+
+
+def test_fault_instants_land_in_the_recovery_trace(tmp_path, w_true):
+    """Restarts are attributable in Perfetto: the run's trace carries the
+    recovery.restore spans AND the injected fault.injected instant."""
+    import jax
+
+    from hivemall_tpu.runtime import faults
+    from hivemall_tpu.runtime.recovery import run_elastic
+    from hivemall_tpu.runtime.tracing import Tracer
+
+    tracer = Tracer(sample_rate=1.0)
+    from hivemall_tpu.runtime import recovery, tracing
+
+    path = str(tmp_path / "ck.npz")
+    plan = faults.FaultPlan(seed=9, faults=(
+        faults.Fault("device_loss", at_step=5, n_lost=1),))
+    saved = (recovery.TRACER, tracing.TRACER, faults.TRACER)
+    recovery.TRACER = tracer
+    faults.TRACER = tracer
+    try:
+        with faults.inject(plan):
+            run_elastic(_make_trainer_factory(path),
+                        lambda t, i: _blk(i, w_true), 8, path,
+                        checkpoint_every=4, devices=list(jax.devices())[:2])
+    finally:
+        recovery.TRACER, tracing.TRACER, faults.TRACER = saved
+
+    traces = tracer.traces()
+    assert traces, "the elastic run must commit a trace"
+    run_trace = traces[-1]
+    names = [s["name"] for s in run_trace["spans"]]
+    assert run_trace["root"] == "recovery.run_elastic"
+    assert names.count("recovery.restore") == 2  # cold start + restart
+    events = [e for s in run_trace["spans"]
+              for e in s.get("events", [])]
+    assert any(e.get("name") == "fault.injected" for e in events), events
+
+
+def test_manifest_is_json_with_striping_metadata(tmp_path, w_true):
+    import jax
+
+    from hivemall_tpu.io.checkpoint import load_elastic
+    from hivemall_tpu.runtime.recovery import checkpoint
+
+    path = str(tmp_path / "ck.npz")
+    trainer, state = _make_trainer_factory(path)(list(jax.devices())[:4])
+    state, _ = trainer.step(state, *_blk(0, w_true))
+    returned = checkpoint(trainer, state, path, block_step=1)
+    _, manifest = load_elastic(path)
+    assert manifest == returned
+    assert manifest["family"] == "sharded"
+    assert manifest["dims"] == DIMS
+    assert manifest["n_shards"] == 4
+    assert manifest["stripe"] == -(-DIMS // 4)
+    assert manifest["dims_padded"] == manifest["stripe"] * 4
+    assert manifest["rule"] == "arow"
+    assert manifest["hyper"] == {"r": 0.1}
+    assert manifest["step"] == 16
+    assert manifest["block_step"] == 1
+    assert manifest["format_version"] == 1
+    json.dumps(manifest)  # fully JSON-able end to end
