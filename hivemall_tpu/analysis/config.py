@@ -133,6 +133,10 @@ DTYPEFLOW_HOT_PREFIXES = (
 # promotion in the quant plumbing fails tier-1 (scripts/lint.sh) before a
 # benchmark ever runs.
 DTYPEFLOW_HOT_MODULES = ("hivemall_tpu/serving/engine.py",
+                         # the sharded score path: per-window widens only
+                         # (G019) and f32 accumulation (G021), same
+                         # contract as the single-device _q8_* scorers
+                         "hivemall_tpu/serving/sharded.py",
                          "hivemall_tpu/io/checkpoint.py")
 HOT_MARKER = "# graftcheck: hot-module"
 
@@ -152,6 +156,9 @@ ARTIFACT_IO_MODULES = (
     "hivemall_tpu/io/checkpoint.py",
     "hivemall_tpu/serving/artifact.py",
     "hivemall_tpu/serving/engine.py",
+    # the sharded load path re-places reloaded tables; its dtype pins live
+    # in host_score_tables but an unpinned asarray HERE would undo them
+    "hivemall_tpu/serving/sharded.py",
 )
 ARTIFACT_MARKER = "# graftcheck: artifact-io"
 

@@ -429,18 +429,9 @@ class ProgramModel:
             for kw in call.keywords:
                 if kw.arg == "axis_names":
                     names = kw.value
-            if isinstance(names, (ast.Tuple, ast.List)):
-                out: Set[str] = set()
-                for elt in names.elts:
-                    s = self._str_of(path, elt, scope)
-                    if s is None:
-                        return None
-                    out.add(s)
-                return out
-            if names is not None:
-                s = self._str_of(path, names, scope)
-                return {s} if s is not None else None
-            return None
+            if names is None:
+                return None
+            return self._axis_name_set(path, names, scope)
         # the registry-default fallbacks below are the REPO's make_mesh /
         # make_mesh_2d conventions: they apply only to the exact bare
         # names (a dotted jax.make_mesh or a make_meshgrid must stay
@@ -465,7 +456,38 @@ class ProgramModel:
             if axis is None:
                 axis = "workers"  # the stock make_mesh default
             return {axis}
+        if tail == "named_mesh" and ("." not in callee
+                                     or "jax_compat" in callee):
+            # the serving-mesh helper (runtime/jax_compat.named_mesh):
+            # axis_names is the 2nd positional or keyword; its signature
+            # default is the serving convention ("batch", "model") — this
+            # is what lets G008 validate PartitionSpecs over the sharded
+            # SERVING load path (serving/placement.py, serving/sharded.py)
+            names = call.args[1] if len(call.args) >= 2 else None
+            for kw in call.keywords:
+                if kw.arg == "axis_names":
+                    names = kw.value
+            if names is None:
+                return {"batch", "model"}
+            return self._axis_name_set(path, names, scope)
         return None
+
+    def _axis_name_set(self, path: str, names: ast.expr,
+                       scope: Optional[ast.AST]) -> Optional[Set[str]]:
+        """Axis-name set of an explicit axis_names expression (tuple/list
+        of resolvable strings, or a single name); None = unresolvable —
+        an explicitly-passed-but-unknown spelling must make the whole
+        mesh unknown, never fall back to a default."""
+        if isinstance(names, (ast.Tuple, ast.List)):
+            out: Set[str] = set()
+            for elt in names.elts:
+                s = self._str_of(path, elt, scope)
+                if s is None:
+                    return None
+                out.add(s)
+            return out
+        s = self._str_of(path, names, scope)
+        return {s} if s is not None else None
 
     def _find_assignment(self, model: ModuleModel, name: str,
                          scope: Optional[ast.AST]) -> Optional[ast.expr]:

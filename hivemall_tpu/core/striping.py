@@ -28,6 +28,24 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 
+def stripe_grid(dims: int, n_shards: int, align: int = 1):
+    """``(stripe, dims_padded)`` for striping a [dims] feature axis across
+    ``n_shards`` devices: the sharded trainers' ceil-pad grid
+    (parallel/sharded_train.py derives ``stripe = ceil(dims/n)``,
+    ``dims_padded = stripe * n``) as a function, so the SERVING load path
+    stripes by the identical arithmetic and a table trained sharded and a
+    table loaded sharded can never land on different grids. ``align``
+    rounds the stripe up to a multiple (int8 scale blocks must not
+    straddle a stripe boundary — serving/sharded.py passes the
+    quant block_rows)."""
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    stripe = -(-dims // n_shards)
+    if align > 1:
+        stripe = -(-stripe // align) * align
+    return stripe, stripe * n_shards
+
+
 def translate_to_stripe(idx, val, shard_axis: str, stripe: int):
     """(local_idx, masked_val): global ids -> this device's stripe-local
     indices (foreign/pad -> the drop slot `stripe`), values masked to 0 on

@@ -26,11 +26,45 @@ collective-safety classes on every version.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 
-__all__ = ["shard_map", "pcast", "HAS_NATIVE_SHARD_MAP"]
+__all__ = ["shard_map", "pcast", "named_mesh", "HAS_NATIVE_SHARD_MAP"]
+
+
+def named_mesh(axis_sizes: Sequence[int],
+               axis_names: Tuple[str, ...] = ("batch", "model"),
+               devices: Optional[Sequence] = None):
+    """A ``jax.sharding.Mesh`` of shape ``axis_sizes`` over the FIRST
+    ``prod(axis_sizes)`` devices, in enumeration order.
+
+    This is the one sanctioned mesh-construction spelling for the serving
+    placements (serving/placement.py) and the G008 analyzer resolves its
+    axis names (default ``("batch", "model")`` — the serving convention).
+    Newer jax ships ``jax.make_mesh``, which may REORDER devices for ICI
+    locality; that reordering is a perf nicety training can afford but
+    serving cannot take by default — stripe ownership must be a pure
+    function of device index so (a) the process-wide sharded-jit cache can
+    key on the device list and (b) a re-deploy on the same host places
+    every stripe on the same chip it was warmed on. Enumeration order is
+    also exactly what parallel/mesh.make_mesh{,_2d} use, so serving and
+    training stripes of the same table land on the same devices."""
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    need = 1
+    for s in axis_sizes:
+        need *= int(s)
+    if len(devices) < need:
+        raise ValueError(
+            f"named_mesh{tuple(axis_sizes)}: needs {need} devices, have "
+            f"{len(devices)}")
+    grid = np.asarray(devices[:need]).reshape(tuple(axis_sizes))
+    from jax.sharding import Mesh
+
+    return Mesh(grid, tuple(axis_names))
 
 HAS_NATIVE_SHARD_MAP = hasattr(jax, "shard_map")
 

@@ -50,6 +50,8 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import threading
 import time
 import warnings
 from dataclasses import asdict, is_dataclass
@@ -393,6 +395,18 @@ def run_elastic(make_trainer: Callable[[Sequence], Tuple[object, object]],
     ``fault.injected`` instants — a restart is visible in Perfetto as a
     restore span sandwiched between step spans.
 
+    **Preemption-aware**: for the duration of the run a SIGTERM handler is
+    installed (main thread only — elsewhere the signal module refuses and
+    the run proceeds without it). On SIGTERM the in-flight step finishes,
+    the state checkpoints IMMEDIATELY — not at the next cadence boundary —
+    and the driver returns early with ``report["preempted"] = True`` and
+    ``report["preempted_at_step"]``, so a preempted pod loses zero
+    completed steps and the next ``run_elastic`` on whatever hardware
+    replaces it resumes from the exact step the eviction interrupted (the
+    cloud-preemption half of elastic training; cadence checkpoints only
+    bound the loss from UNANNOUNCED failures). The previous handler is
+    restored on exit.
+
     Returns ``(trainer, state, report)``; the report carries restarts,
     per-restart causes, lost (replayed) steps, checkpoints written, and
     recovery seconds — the numbers scripts/bench_chaos.py publishes."""
@@ -404,7 +418,28 @@ def run_elastic(make_trainer: Callable[[Sequence], Tuple[object, object]],
     devices = list(devices if devices is not None else jax.devices())
     report = {"restarts": 0, "causes": [], "lost_steps": 0,
               "checkpoints_written": 0, "recovery_s": 0.0,
+              "preempted": False,
               "initial_devices": len(devices), "final_devices": len(devices)}
+    term = threading.Event()
+    prev_handler = None
+    if threading.current_thread() is threading.main_thread():
+        try:
+            prev_handler = signal.signal(
+                signal.SIGTERM, lambda signum, frame: term.set())
+        except ValueError:  # exotic embeddings where signal still refuses
+            prev_handler = None
+    try:
+        return _run_elastic_loop(make_trainer, data_fn, n_steps, path,
+                                 checkpoint_every, max_restarts, devices,
+                                 recoverable, min_devices, report, term)
+    finally:
+        if prev_handler is not None:
+            signal.signal(signal.SIGTERM, prev_handler)
+
+
+def _run_elastic_loop(make_trainer, data_fn, n_steps, path, checkpoint_every,
+                      max_restarts, devices, recoverable, min_devices,
+                      report, term):
     with TRACER.span("recovery.run_elastic",
                      args={"n_steps": int(n_steps), "path": path}):
         while True:
@@ -443,6 +478,19 @@ def run_elastic(make_trainer: Callable[[Sequence], Tuple[object, object]],
                     with TRACER.span("train.step", args={"step": i}):
                         state, loss = trainer.step(state, *data_fn(trainer, i))
                     completed = i + 1
+                    if term.is_set():
+                        # SIGTERM landed: checkpoint the completed step NOW
+                        # instead of waiting for the cadence, then hand
+                        # control back so the process can exit inside its
+                        # grace period — the next run_elastic resumes here
+                        checkpoint(trainer, state, path, block_step=i + 1)
+                        report["checkpoints_written"] += 1
+                        report["preempted"] = True
+                        report["preempted_at_step"] = i + 1
+                        report["final_devices"] = len(devices)
+                        TRACER.instant("recovery.preempted",
+                                       args={"step": i + 1})
+                        return trainer, state, report
                     if (i + 1) % checkpoint_every == 0:
                         checkpoint(trainer, state, path, block_step=i + 1)
                         report["checkpoints_written"] += 1

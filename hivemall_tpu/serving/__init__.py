@@ -11,12 +11,16 @@ hot-swap registry + /predict endpoint — docs/serving.md.
 
 from .artifact import Artifact, family_of, freeze, load
 from .batcher import BatcherClosed, DynamicBatcher, QueueFull
-from .engine import ServingEngine, make_servable
+from .engine import Servable, ServingEngine, make_servable
+from .placement import (ModelExceedsDeviceBudget, ModelSharded, Placement,
+                        Replicated, SingleDevice)
 from .server import ModelEntry, ModelRegistry, serve
 
 __all__ = [
     "Artifact", "family_of", "freeze", "load",
     "DynamicBatcher", "QueueFull", "BatcherClosed",
-    "ServingEngine", "make_servable",
+    "Servable", "ServingEngine", "make_servable",
+    "Placement", "SingleDevice", "Replicated", "ModelSharded",
+    "ModelExceedsDeviceBudget",
     "ModelRegistry", "ModelEntry", "serve",
 ]

@@ -437,6 +437,138 @@ def load(path: str, verify: bool = True) -> Artifact:
     return Artifact(path=path, manifest=manifest, arrays=arrays)
 
 
+# Families the model-sharded placement can stripe: the ones whose score
+# path is gathers against float (or int8+scale) weight tables along one
+# axis. Trees walk int32 structure and FFM rides an opaque codec blob —
+# neither has a stripeable table, so sharded placement refuses loudly.
+SHARDABLE_FAMILIES = ("linear", "multiclass", "fm", "mf")
+
+
+def host_score_tables(source) -> dict:
+    """Family-normalized HOST view of the score-path tables — the sharded
+    load path's input (serving/sharded.py stripes these with
+    ``NamedSharding`` over the serving mesh).
+
+    ``source`` is an :class:`Artifact` or a trained model. Returns::
+
+        {"family": str,
+         "weights_dtype": str,              # the dtype tables SERVE at
+         "quant": None | manifest quant block,
+         "meta": {...},                     # dims / label_vocab / factors /
+                                            # classification / use_bias / ...
+         "striped": [(name, array, axis, grid)],
+         "scales": {name: f32 scale array}, # int8 only, same axis as name
+         "replicated": {name: array}}       # w0 / mu — tiny, every device
+
+    ``grid`` names which id space the striped axis gathers by
+    ("features" for linear/multiclass/FM, "users"/"items" for MF) — each
+    grid gets its own stripe arithmetic (core.striping.stripe_grid).
+    Arrays come back at their SERVING dtype: the manifest dtype pin (G020)
+    is applied HERE, so a bf16-trained table leaves as a host bf16 array
+    (never the widened-at-rest f32) and int8 tables leave as int8 plus
+    their f32 scales. The score path has no covariances and no optimizer
+    slots by construction — only what a request's gathers actually read
+    stripes, which is also what per-device budget checks meter."""
+    from ..io.checkpoint import (QUANT_SCHEME_BF16, SCALE_SUFFIX,
+                                 bf16_unpack_raw, dense_from_rows)
+
+    if isinstance(source, Artifact):
+        family, a, meta = source.family, source.arrays, dict(source.meta)
+        quant = manifest_quant(source.meta)
+    else:
+        family, a, meta, quant = family_of(source), None, {}, None
+    if family not in SHARDABLE_FAMILIES:
+        raise ValueError(
+            f"family {family!r} has no sharded serving path (stripeable "
+            f"families: {', '.join(SHARDABLE_FAMILIES)}); serve it "
+            f"single-device")
+
+    out = {"family": family, "quant": quant, "meta": meta,
+           "striped": [], "scales": {}, "replicated": {}}
+
+    def table(name, out_name=None):
+        """Pack entry at its serving dtype (artifact source only);
+        ``out_name`` keys int8 scales when the striped name differs from
+        the pack name (linear stores "weight", serves as "weights")."""
+        if quant is None:
+            # the manifest dtype pin: the pack stores reduced tables
+            # widened value-exactly; reload at the TRAINED width (G020)
+            return np.asarray(a[name]).astype(manifest_dtype(meta))
+        if quant["scheme"] == QUANT_SCHEME_BF16:
+            return bf16_unpack_raw(a[name])
+        out["scales"][out_name or name] = np.asarray(a[name + SCALE_SUFFIX],
+                                                     np.float32)
+        return np.asarray(a[name], np.int8)
+
+    if a is not None:  # ---- artifact source --------------------------------
+        out["weights_dtype"] = meta.get("weights_dtype", "float32")
+        if family == "linear":
+            if quant is None:
+                w, _ = dense_from_rows(int(meta["dims"]), a["feature"],
+                                       a["weight"], None)
+                w = w.astype(manifest_dtype(meta))
+            else:
+                w = table("weight", out_name="weights")
+            out["striped"].append(("weights", w, 0, "features"))
+        elif family == "multiclass":
+            out["striped"].append(("weights", table("weights"), 1,
+                                   "features"))
+        elif family == "fm":
+            out["striped"] += [("w", table("w"), 0, "features"),
+                               ("v", table("v"), 0, "features")]
+            out["replicated"]["w0"] = np.asarray(a["w0"], np.float32)
+        else:  # mf
+            out["striped"] += [("P", table("P"), 0, "users"),
+                               ("Q", table("Q"), 0, "items"),
+                               ("Bu", np.asarray(a["Bu"], np.float32), 0,
+                                "users"),
+                               ("Bi", np.asarray(a["Bi"], np.float32), 0,
+                                "items")]
+            out["replicated"]["mu"] = np.asarray(a["mu"], np.float32)
+            meta.setdefault("num_users", int(out["striped"][0][1].shape[0]))
+            meta.setdefault("num_items", int(out["striped"][1][1].shape[0]))
+        return out
+
+    # ---- live trained model -------------------------------------------------
+    import jax
+
+    def host(x):
+        return np.asarray(jax.device_get(x))
+
+    if family == "linear":
+        w = host(source.state.weights)
+        out["striped"].append(("weights", w, 0, "features"))
+        meta["dims"] = int(source.dims)
+    elif family == "multiclass":
+        w = host(source.state.weights)
+        out["striped"].append(("weights", w, 1, "features"))
+        meta.update(dims=int(source.dims),
+                    label_vocab=list(source.label_vocab))
+    elif family == "fm":
+        st = source.state
+        w = host(st.w)
+        out["striped"] += [("w", w, 0, "features"),
+                           ("v", host(st.v), 0, "features")]
+        out["replicated"]["w0"] = np.asarray(host(st.w0), np.float32)
+        meta.update(dims=int(source.dims),
+                    classification=bool(source.hyper.classification))
+    else:  # mf
+        st = source.state
+        w = host(st.P)
+        out["striped"] += [("P", w, 0, "users"),
+                           ("Q", host(st.Q), 0, "items"),
+                           ("Bu", np.asarray(host(st.Bu), np.float32), 0,
+                            "users"),
+                           ("Bi", np.asarray(host(st.Bi), np.float32), 0,
+                            "items")]
+        out["replicated"]["mu"] = np.asarray(host(st.mu), np.float32)
+        meta.update(use_bias=bool(source.use_bias),
+                    num_users=int(w.shape[0]),
+                    num_items=int(out["striped"][1][1].shape[0]))
+    out["weights_dtype"] = np.dtype(w.dtype).name
+    return out
+
+
 def rebuild_model(artifact: Artifact):
     """Reconstruct a predictable model object from an artifact.
 

@@ -136,7 +136,14 @@ def _quant_jit_fns() -> dict:
 
 
 class _Servable:
-    """Family adapter: host staging + padded jitted scoring.
+    """THE servable protocol: host staging + padded scoring, placement-free.
+
+    Every placement (single-device, replicated, model-sharded —
+    serving/placement.py) serves through this same interface; the engine,
+    batcher, registry and /predict endpoint depend on nothing else. The
+    single-device family adapters below implement it with tables on one
+    device; serving/sharded.py implements it with NamedSharding-striped
+    tables — ``make_servable(obj, placement=...)`` picks.
 
     The request path is three explicitly separated stages so the tracer
     (runtime/tracing.py) can attribute time per stage:
@@ -161,6 +168,11 @@ class _Servable:
     # the dtype the weight tables SERVE at (the manifest weights_dtype for
     # artifacts) — surfaced per model on /models and /metrics
     weights_dtype: str = "float32"
+    # placement surface: single-device servables leave the defaults; the
+    # sharded servables (serving/sharded.py) fill in their mesh shape and
+    # the /models placement block
+    mesh_shape: Optional[Tuple[int, ...]] = None
+    placement_info: Optional[dict] = None
 
     def device_tables(self):
         """The resident score tables (arrays or pytrees of arrays) —
@@ -897,20 +909,51 @@ _WARMUP_DUMMIES: dict = {}
 
 
 def _warmup_dummy(servable: _Servable, width: int):
-    key = (servable.family, width, getattr(servable, "n_features", None))
+    # mesh shape is part of the key: a sharded servable's warmup sweep is
+    # logically per-mesh (the jit caches it fills are keyed by mesh), so a
+    # (1, 4) engine must not hand its cache hit to a (2, 2) one — even
+    # though the dummy CONTENT only depends on shape, keeping the keys
+    # honest keeps the dedup test meaningful per mesh
+    key = (servable.family, width, getattr(servable, "n_features", None),
+           servable.mesh_shape)
     inst = _WARMUP_DUMMIES.get(key)
     if inst is None:
         inst = _WARMUP_DUMMIES[key] = servable.dummy_instance(width)
     return inst
 
 
-def make_servable(obj) -> _Servable:
-    """Artifact | artifact dir path | trained model -> family servable."""
+# the protocol's public name: external servable implementations (and type
+# hints) should spell it Servable; the underscore spelling predates the
+# placement refactor and the in-tree adapters keep it
+Servable = _Servable
+
+
+def make_servable(obj, placement=None) -> _Servable:
+    """Artifact | artifact dir path | trained model -> family servable.
+
+    ``placement`` (None | kind string | serving.placement.Placement)
+    decides where the score tables live: the default single-device
+    adapters below, or the NamedSharding-striped servables of
+    serving/sharded.py for ``replicated`` / ``model_sharded``. A
+    ``device_byte_budget`` on the placement is enforced here — a model
+    whose per-device resident score-table bytes exceed it refuses to load
+    (ModelExceedsDeviceBudget) instead of OOMing at first request."""
+    from .placement import resolve_placement
+
+    placement = resolve_placement(placement)
     if isinstance(obj, str):
         obj = load(obj)
-    if isinstance(obj, Artifact):
-        return _servable_from_artifact(obj)
-    return _servable_from_model(obj)
+    if placement.kind != "single_device":
+        from .sharded import sharded_servable
+
+        return sharded_servable(obj, placement)
+    servable = _servable_from_artifact(obj) if isinstance(obj, Artifact) \
+        else _servable_from_model(obj)
+    if placement.device_byte_budget is not None:
+        placement.check_budget(servable.table_bytes(),
+                               f"{servable.family} model "
+                               f"({servable.weights_dtype})")
+    return servable
 
 
 class ServingEngine:
@@ -924,11 +967,22 @@ class ServingEngine:
 
     def __init__(self, source, *, name: str = "default",
                  max_batch: int = 512, max_width: int = 256,
-                 min_batch_bucket: int = 8) -> None:
+                 min_batch_bucket: int = 8, placement=None) -> None:
         if max_batch < min_batch_bucket:
             raise ValueError("max_batch must be >= min_batch_bucket")
         self.servable = source if isinstance(source, _Servable) \
-            else make_servable(source)
+            else make_servable(source, placement=placement)
+        self.placement = self.servable.placement_info or \
+            {"kind": "single_device", "devices": 1, "mesh_shape": None,
+             "batch_shards": 1, "model_shards": 1}
+        bs = int(self.placement.get("batch_shards", 1))
+        if bs > 1 and (min_batch_bucket % bs or max_batch % bs):
+            # every batch bucket must split evenly over the batch axis —
+            # buckets are min_batch_bucket * 2^k capped at max_batch, so
+            # divisibility of the two ends covers the whole ladder
+            raise ValueError(
+                f"batch_shards={bs} must divide min_batch_bucket "
+                f"({min_batch_bucket}) and max_batch ({max_batch})")
         self.family = self.servable.family
         self.name = name
         self.max_batch = int(max_batch)
@@ -948,6 +1002,14 @@ class ServingEngine:
                            float(self.table_bytes))
         REGISTRY.set_gauge(f"serving.{name}.weights_bits",
                            float(_dtype_bits(self.weights_dtype)))
+        # placement gauges: how many devices this model's bytes spread over
+        # and what one device actually holds (total for single-device)
+        self.per_device_table_bytes = int(getattr(
+            self.servable, "per_device_table_bytes", 0)) or self.table_bytes
+        REGISTRY.set_gauge(f"serving.{name}.model_shards",
+                           float(self.placement.get("model_shards", 1)))
+        REGISTRY.set_gauge(f"serving.{name}.per_device_table_bytes",
+                           float(self.per_device_table_bytes))
 
     # -- buckets -------------------------------------------------------------
 

@@ -1,0 +1,200 @@
+"""Pluggable servable placement — where a model's score tables live.
+
+The serving engine historically had exactly one answer: every table on one
+device, so the biggest serveable model was the smallest device's memory —
+while training stripes 2^22+-dim tables across a whole mesh
+(parallel/sharded.py, core/striping.py) and N-1 devices idled at
+inference. Placement makes the answer a parameter of ``make_servable`` /
+``ServingEngine`` instead of a property of the servable classes:
+
+- ``SingleDevice()``   — the default; the existing per-family servables,
+  tables wherever jax puts them (one device);
+- ``Replicated()``     — every device holds the full tables; request
+  batches shard along the ``batch`` mesh axis (throughput from idle
+  devices, no size headroom);
+- ``ModelSharded(n)``  — tables stripe along the feature axis over the
+  ``model`` mesh axis with ``NamedSharding`` (serving/sharded.py), batches
+  optionally shard along ``batch``: a table bigger than one device serves.
+
+All three run behind the same ``Servable`` protocol (serving/engine.py):
+stage → dispatch → finalize, bucketed and warmed identically, so the
+zero-steady-state-recompile guarantee holds per placement and the batcher,
+registry and /predict endpoint never see the difference.
+
+``Replicated`` IS ``ModelSharded`` with a ``(n, 1)`` mesh: a stripe that
+spans the whole table is a replica, and the shared sharded score path
+degenerates to the single-device math (the psum over a size-1 axis is the
+identity). One implementation, three placements.
+
+``device_byte_budget`` simulates a device memory ceiling: a placement
+refuses (``ModelExceedsDeviceBudget``) at load when its per-device
+resident score-table bytes exceed the budget — scripts/bench_serving.py
+``--sharded`` uses it to demonstrate a model that only fits sharded, and
+operators can pin deploys to a known HBM headroom.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+# The serving mesh axes. Distinct from the training axes
+# (parallel/mesh.py: "workers"/"shards") on purpose: a serving mesh is
+# request-batch x table-stripe, not replica x stripe, and G008 validates
+# PartitionSpecs against whichever mesh is actually in scope.
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+
+class ModelExceedsDeviceBudget(ValueError):
+    """Per-device resident score-table bytes exceed the placement's
+    ``device_byte_budget`` — the model does not fit this placement; shard
+    it (or raise the budget)."""
+
+
+class Placement:
+    """Base placement: single-device (the historical behavior)."""
+
+    kind = "single_device"
+
+    def __init__(self, device_byte_budget: Optional[int] = None) -> None:
+        self.device_byte_budget = (None if device_byte_budget is None
+                                   else int(device_byte_budget))
+
+    # -- mesh geometry (trivial for single-device) --------------------------
+
+    @property
+    def batch_shards(self) -> int:
+        return 1
+
+    @property
+    def model_shards(self) -> int:
+        return 1
+
+    def describe(self) -> dict:
+        """The /models placement block: what an operator needs to see to
+        know where a deployed model's bytes actually are."""
+        return {"kind": self.kind, "devices": 1, "mesh_shape": None,
+                "batch_shards": self.batch_shards,
+                "model_shards": self.model_shards}
+
+    def check_budget(self, per_device_bytes: int, what: str) -> None:
+        if self.device_byte_budget is not None \
+                and per_device_bytes > self.device_byte_budget:
+            raise ModelExceedsDeviceBudget(
+                f"{what}: {per_device_bytes} resident score-table bytes per "
+                f"device exceed the {self.kind} placement's budget of "
+                f"{self.device_byte_budget} bytes — serve it model-sharded "
+                f"(ModelSharded) or raise device_byte_budget")
+
+
+SingleDevice = Placement
+
+
+class ModelSharded(Placement):
+    """Stripe the score tables over ``model_shards`` devices; shard request
+    batches over ``batch_shards``. The mesh is ``(batch, model)`` —
+    ``named_mesh`` over the first ``batch_shards * model_shards`` devices
+    (runtime/jax_compat.py), matching the SNIPPETS Mesh/NamedSharding/
+    PartitionSpec serving pattern. ``model_shards=None`` takes every
+    available device."""
+
+    kind = "model_sharded"
+
+    def __init__(self, model_shards: Optional[int] = None, *,
+                 batch_shards: int = 1,
+                 devices: Optional[Sequence] = None,
+                 device_byte_budget: Optional[int] = None) -> None:
+        super().__init__(device_byte_budget)
+        if model_shards is not None and model_shards < 1:
+            raise ValueError(f"model_shards must be >= 1, got {model_shards}")
+        if batch_shards < 1 or (batch_shards & (batch_shards - 1)):
+            # batch buckets are powers of two (engine.batch_buckets), so a
+            # non-power-of-two batch axis could never divide them evenly
+            raise ValueError(
+                f"batch_shards must be a power of two, got {batch_shards}")
+        self._model_shards = model_shards
+        self._batch_shards = int(batch_shards)
+        self._devices = list(devices) if devices is not None else None
+        self._mesh = None
+
+    @property
+    def batch_shards(self) -> int:
+        return self._batch_shards
+
+    @property
+    def model_shards(self) -> int:
+        if self._model_shards is None:
+            import jax
+
+            n = len(self._devices) if self._devices is not None \
+                else jax.device_count()
+            self._model_shards = max(1, n // self._batch_shards)
+        return self._model_shards
+
+    def mesh(self):
+        """The (batch, model) serving mesh — built once, cached (every
+        servable of this placement places onto the SAME mesh object, and
+        the sharded-jit cache keys on its device list)."""
+        if self._mesh is None:
+            from ..runtime.jax_compat import named_mesh
+
+            self._mesh = named_mesh(
+                (self.batch_shards, self.model_shards),
+                (BATCH_AXIS, MODEL_AXIS), self._devices)
+        return self._mesh
+
+    def describe(self) -> dict:
+        shape = (self.batch_shards, self.model_shards)
+        return {"kind": self.kind,
+                "devices": shape[0] * shape[1],
+                "mesh_shape": list(shape),
+                "mesh_axes": [BATCH_AXIS, MODEL_AXIS],
+                "batch_shards": self.batch_shards,
+                "model_shards": self.model_shards}
+
+
+class Replicated(ModelSharded):
+    """Full tables on every device, batches sharded across all of them —
+    the (n, 1) corner of the sharded placement (see module docstring)."""
+
+    kind = "replicated"
+
+    def __init__(self, batch_shards: Optional[int] = None, *,
+                 devices: Optional[Sequence] = None,
+                 device_byte_budget: Optional[int] = None) -> None:
+        if batch_shards is None:
+            import jax
+
+            n = len(devices) if devices is not None else jax.device_count()
+            # largest power of two that fits the device count, capped at
+            # the engine's default min_batch_bucket (8): every batch
+            # bucket must split evenly over the batch axis, so a bigger
+            # default would refuse to construct on big hosts — pass
+            # batch_shards (and a matching min_batch_bucket) explicitly
+            # to spread wider
+            batch_shards = min(1 << (max(1, n).bit_length() - 1), 8)
+        super().__init__(model_shards=1, batch_shards=batch_shards,
+                         devices=devices,
+                         device_byte_budget=device_byte_budget)
+
+
+_BY_NAME = {"single_device": SingleDevice, "replicated": Replicated,
+            "model_sharded": ModelSharded, "sharded": ModelSharded}
+
+
+def resolve_placement(placement: Union[None, str, Placement]) -> Placement:
+    """None | kind-string | Placement -> Placement (the make_servable /
+    ServingEngine / ModelRegistry.deploy argument surface)."""
+    if placement is None:
+        return SingleDevice()
+    if isinstance(placement, str):
+        try:
+            return _BY_NAME[placement]()
+        except KeyError:
+            raise ValueError(
+                f"unknown placement {placement!r}; one of "
+                f"{sorted(_BY_NAME)}") from None
+    if isinstance(placement, Placement):
+        return placement
+    raise TypeError(f"placement must be None, a kind string, or a "
+                    f"Placement, got {type(placement).__name__}")

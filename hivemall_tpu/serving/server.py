@@ -63,6 +63,11 @@ class ModelEntry:
             # .weights_bits on /metrics)
             "weights_dtype": self.engine.weights_dtype,
             "table_bytes": self.engine.table_bytes,
+            # where those bytes live: single-device, replicated, or
+            # NamedSharding-striped over a (batch, model) mesh — including
+            # mesh shape, stripe grids and per-device resident bytes
+            # (docs/serving.md "Sharded serving")
+            "placement": self.engine.placement,
         }
 
 

@@ -1,0 +1,515 @@
+"""Model-sharded servables — score models bigger than one device.
+
+Training stripes the hashed weight table across a mesh (parallel/sharded.py,
+core/striping.py); this module gives SERVING the same headroom: an
+artifact's score tables load with ``NamedSharding`` over the placement's
+``(batch, model)`` mesh — each device holds one [stripe] slice of every
+striped table, request batches shard along ``batch`` — so a table that
+exceeds one device's memory serves, and the N-1 devices that idled under
+single-device placement do work (the ads-serving shape: auction scoring
+against sharded embedding tables, PAPERS.md).
+
+Three invariants carried over from the single-device engine:
+
+- **bit-compatible striping.** The load path pads and stripes with
+  ``core.striping.stripe_grid`` / ``restripe_array`` — the sharded
+  trainers' own grid arithmetic — and scores through the SAME per-device
+  bodies training uses (``parallel.sharded.stripe_score``,
+  ``models.fm.sharded_gather_predict``), so a served-sharded score cannot
+  drift from a trained-sharded one.
+- **dequant-free quantized scoring.** int8 tables stripe as int8 with
+  their f32 scale arrays striped on the block grid — the stripe is
+  aligned up to ``block_rows`` (stripe_grid's ``align``), so a scale
+  block never straddles devices and ``local_id >> block_shift`` indexes
+  the local scale slice directly; bf16 tables stripe AT bf16 and each
+  gathered window widens per-window (G019) exactly like the single-device
+  ``_q8_*`` scorers.
+- **zero steady-state recompiles.** The sharded jitted scorers are
+  process-shared, keyed by (family kind, mesh device list, stripe[,
+  block_shift]) in ``_SHARDED_JIT`` — a second engine on the same mesh
+  warms for free — and ``ServingEngine.warmup`` sweeps every
+  (batch, width) bucket through them exactly as single-device, witnessed
+  live by recompile_guard.
+
+Staging is untouched: the sharded servables inherit the sparse-row / pair
+staging of their single-device counterparts (serving/engine.py), so
+request parsing, width bucketing, pad lanes (index == dims, value 0) and
+the pre-parsed request forms behave identically — ``translate_to_stripe``
+routes every lane to its owning stripe on device, foreign/pad lanes
+contributing exactly 0.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.striping import restripe_array, stripe_grid
+from .artifact import host_score_tables
+from .engine import _ArgmaxLabelServable, _PairServable, _SparseRowServable
+from .placement import BATCH_AXIS, MODEL_AXIS, ModelSharded
+
+# Process-shared sharded scorers: (kind, mesh key, stripe grid, block) ->
+# jitted shard_map product. Plain dict mutation is GIL-atomic (the
+# _WARMUP_DUMMIES argument); a racing deploy at worst builds one duplicate.
+_SHARDED_JIT: dict = {}
+
+
+def _mesh_key(mesh):
+    return (tuple(int(d.id) for d in mesh.devices.flat),
+            tuple(int(s) for s in mesh.devices.shape))
+
+
+def _sharded_jit(kind: str, mesh, grid: tuple,
+                 block_shift: Optional[int] = None,
+                 use_bias: bool = False):
+    key = (kind, _mesh_key(mesh), grid, block_shift, use_bias)
+    fn = _SHARDED_JIT.get(key)
+    if fn is None:
+        fn = _SHARDED_JIT[key] = _BUILDERS[kind](
+            mesh, grid, block_shift=block_shift, use_bias=use_bias)
+    return fn
+
+
+# --- per-family sharded score bodies ----------------------------------------
+# Each builder returns jax.jit(shard_map(body)) for one (mesh, stripe
+# grid). Tables arrive pre-placed with the matching NamedSharding, so
+# dispatch never reshards; idx/val arrive as host arrays and take the
+# in_specs placement (batch-sharded, replicated over model).
+
+
+def _build_linear(mesh, grid, block_shift=None, use_bias=False):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharded import stripe_score
+    from ..runtime.jax_compat import shard_map
+
+    (stripe,) = grid
+    # the per-device body shared with ShardedTrainer.make_predict — serving
+    # and training stripe scoring are the same function
+    fn = shard_map(stripe_score(MODEL_AXIS, stripe), mesh=mesh,
+                   in_specs=(P(MODEL_AXIS), P(BATCH_AXIS), P(BATCH_AXIS)),
+                   out_specs=P(BATCH_AXIS))
+    return jax.jit(fn)
+
+
+def _build_q8_linear(mesh, grid, block_shift=None, use_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.striping import translate_to_stripe
+    from ..runtime.jax_compat import shard_map
+
+    (stripe,) = grid
+
+    def local(qw_l, s_l, idx, val):
+        lidx, vmask = translate_to_stripe(idx, val, MODEL_AXIS, stripe)
+        wq = qw_l.at[lidx].get(mode="fill", fill_value=0)
+        sg = s_l.at[lidx >> block_shift].get(mode="fill", fill_value=0.0)
+        # per-window dequant (G019): only the gathered [B, K] rows widen,
+        # the scale folds into the product, the sum accumulates f32 (G021)
+        return jax.lax.psum(
+            jnp.sum(wq.astype(jnp.float32) * sg * vmask, axis=-1),
+            MODEL_AXIS)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(MODEL_AXIS), P(MODEL_AXIS), P(BATCH_AXIS),
+                             P(BATCH_AXIS)),
+                   out_specs=P(BATCH_AXIS))
+    return jax.jit(fn)
+
+
+def _build_multiclass(mesh, grid, block_shift=None, use_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.striping import translate_to_stripe
+    from ..runtime.jax_compat import shard_map
+
+    (stripe,) = grid
+
+    def local(W_l, idx, val):
+        lidx, vmask = translate_to_stripe(idx, val, MODEL_AXIS, stripe)
+        Wg = jnp.take(W_l, lidx, axis=1, mode="fill", fill_value=0.0)
+        return jax.lax.psum(jnp.einsum("lbk,bk->bl", Wg, vmask), MODEL_AXIS)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(None, MODEL_AXIS), P(BATCH_AXIS),
+                             P(BATCH_AXIS)),
+                   out_specs=P(BATCH_AXIS))
+    return jax.jit(fn)
+
+
+def _build_q8_multiclass(mesh, grid, block_shift=None, use_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.striping import translate_to_stripe
+    from ..runtime.jax_compat import shard_map
+
+    (stripe,) = grid
+
+    def local(qW_l, s_l, idx, val):
+        lidx, vmask = translate_to_stripe(idx, val, MODEL_AXIS, stripe)
+        Wq = jnp.take(qW_l, lidx, axis=1, mode="fill", fill_value=0)
+        S = jnp.take(s_l, lidx >> block_shift, axis=1, mode="fill",
+                     fill_value=0.0)
+        return jax.lax.psum(
+            jnp.einsum("lbk,bk->bl", Wq.astype(jnp.float32) * S, vmask),
+            MODEL_AXIS)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(None, MODEL_AXIS), P(None, MODEL_AXIS),
+                             P(BATCH_AXIS), P(BATCH_AXIS)),
+                   out_specs=P(BATCH_AXIS))
+    return jax.jit(fn)
+
+
+def _build_fm(mesh, grid, block_shift=None, use_bias=False):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.fm import sharded_gather_predict
+    from ..runtime.jax_compat import shard_map
+
+    (stripe,) = grid
+
+    def local(w0, w_l, v_l, idx, val):
+        # the ONE copy of feature-sharded FM prediction, shared with the
+        # sharded train step — p is its 5th output
+        return sharded_gather_predict(w_l, v_l, w0, idx, val, MODEL_AXIS,
+                                      stripe)[4]
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P(MODEL_AXIS), P(MODEL_AXIS),
+                             P(BATCH_AXIS), P(BATCH_AXIS)),
+                   out_specs=P(BATCH_AXIS))
+    return jax.jit(fn)
+
+
+def _build_q8_fm(mesh, grid, block_shift=None, use_bias=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.striping import translate_to_stripe
+    from ..runtime.jax_compat import shard_map
+
+    (stripe,) = grid
+
+    def local(w0, qw_l, ws_l, qv_l, vs_l, idx, val):
+        lidx, vmask = translate_to_stripe(idx, val, MODEL_AXIS, stripe)
+        sw = ws_l.at[lidx >> block_shift].get(mode="fill", fill_value=0.0)
+        wg = qw_l.at[lidx].get(mode="fill",
+                               fill_value=0).astype(jnp.float32) * sw
+        sv = vs_l.at[lidx >> block_shift].get(mode="fill", fill_value=0.0)
+        vg = qv_l.at[lidx].get(mode="fill",
+                               fill_value=0).astype(jnp.float32) * sv
+        vx = vg * vmask[..., None]
+        linear, sum_vfx, sum_v2x2 = jax.lax.psum(
+            (jnp.sum(wg * vmask, axis=-1),
+             jnp.sum(vx, axis=-2),
+             jnp.sum(vx * vx, axis=-2)), MODEL_AXIS)
+        return w0 + linear + 0.5 * jnp.sum(sum_vfx * sum_vfx - sum_v2x2,
+                                           axis=-1)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P(MODEL_AXIS), P(MODEL_AXIS),
+                             P(MODEL_AXIS), P(MODEL_AXIS), P(BATCH_AXIS),
+                             P(BATCH_AXIS)),
+                   out_specs=P(BATCH_AXIS))
+    return jax.jit(fn)
+
+
+def _build_mf(mesh, grid, block_shift=None, use_bias=False):
+    """MF pair scoring over striped P/Q/Bu/Bi: each device contributes the
+    rows it owns (foreign ids hit the drop slot and gather 0), one fused
+    psum assembles the full gathered windows, the dot product runs on the
+    assembled f32 windows. ``block_shift`` set means int8 tables with
+    scale arrays riding along (two extra striped inputs)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.striping import translate_to_stripe
+    from ..runtime.jax_compat import shard_map
+
+    stripe_u, stripe_i = grid
+    quant = block_shift is not None
+
+    def gather(table, scales, ids, stripe):
+        lid, _ = translate_to_stripe(ids, jnp.ones(ids.shape, jnp.float32),
+                                     MODEL_AXIS, stripe)
+        g = table.at[lid].get(mode="fill", fill_value=0)
+        g = g.astype(jnp.float32)  # per-window widen (G019): bf16/int8
+        if scales is not None:
+            g = g * scales.at[lid >> block_shift].get(mode="fill",
+                                                      fill_value=0.0)
+        return g, lid
+
+    def local(P_l, Q_l, Bu_l, Bi_l, mu, ps_l, qs_l, u, i):
+        Pg, lu = gather(P_l, ps_l if quant else None, u, stripe_u)
+        Qg, li = gather(Q_l, qs_l if quant else None, i, stripe_i)
+        bu = Bu_l.at[lu].get(mode="fill", fill_value=0.0)
+        bi = Bi_l.at[li].get(mode="fill", fill_value=0.0)
+        Pg, Qg, bu, bi = jax.lax.psum((Pg, Qg, bu, bi), MODEL_AXIS)
+        out = jnp.sum(Pg * Qg, axis=-1) + mu
+        if use_bias:
+            out = out + bu + bi
+        return out
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(MODEL_AXIS), P(MODEL_AXIS), P(MODEL_AXIS),
+                             P(MODEL_AXIS), P(), P(MODEL_AXIS),
+                             P(MODEL_AXIS), P(BATCH_AXIS), P(BATCH_AXIS)),
+                   out_specs=P(BATCH_AXIS))
+    return jax.jit(fn)
+
+
+_BUILDERS = {"linear": _build_linear, "q8_linear": _build_q8_linear,
+             "multiclass": _build_multiclass,
+             "q8_multiclass": _build_q8_multiclass,
+             "fm": _build_fm, "q8_fm": _build_q8_fm, "mf": _build_mf}
+
+
+# --- table placement ---------------------------------------------------------
+
+
+def _stripe_put(arr, axis: int, dims: int, dims_padded: int, mesh):
+    """Pad a host table to the stripe grid (core.striping.restripe_array —
+    the elastic-resume pad math) and place it striped along ``axis`` over
+    the mesh's model axis. Weight fills are always 0 (the score path has
+    no covariances, whose fill would be 1)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    a = restripe_array(np.asarray(arr), axis, dims, dims_padded, fill=0)
+    spec = [None] * a.ndim
+    spec[axis] = MODEL_AXIS
+    return jax.device_put(a, NamedSharding(mesh, P(*spec)))
+
+
+def _replicate_put(arr, mesh):
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    return jax.device_put(np.asarray(arr), NamedSharding(mesh, P()))
+
+
+# --- the sharded servables ---------------------------------------------------
+
+
+class _ShardedMixin:
+    """Placement bookkeeping shared by every sharded servable: what the
+    engine surfaces on /models (placement_info), what the warmup-dummy
+    cache keys on (mesh_shape), and what budget checks meter
+    (per_device_table_bytes)."""
+
+    mesh_shape: tuple = ()
+    per_device_table_bytes: int = 0
+    placement_info: Optional[dict] = None
+
+    def _init_placement(self, placement: ModelSharded, spec: dict,
+                        grids: dict) -> None:
+        mesh = placement.mesh()
+        self.mesh_shape = tuple(int(s) for s in mesh.devices.shape)
+        self.weights_dtype = spec["weights_dtype"]
+        self.placement_info = dict(placement.describe())
+        self.placement_info["stripe_grids"] = {
+            g: {"dims": d, "stripe": s, "dims_padded": p}
+            for g, (d, s, p) in grids.items()}
+
+    def device_tables(self):
+        # dedupe by identity: the fixed-arity MF body takes Bu/Bi again as
+        # inert scale stand-ins on non-quantized runs, which must not
+        # double-count in table_bytes
+        seen, out = set(), []
+        for t in self._tables:
+            if id(t) not in seen:
+                seen.add(id(t))
+                out.append(t)
+        return out
+
+
+class _ShardedRowServable(_ShardedMixin, _SparseRowServable):
+    """Sharded sparse-row families (linear / FM, any precision): staging
+    inherited from the single-device path, dispatch through the
+    process-shared sharded jit."""
+
+    def __init__(self, kind: str, family: str, tables, dims: int,
+                 placement: ModelSharded, grid: tuple,
+                 block_shift: Optional[int] = None) -> None:
+        _SparseRowServable.__init__(self, dims)
+        self.family = family
+        self._tables = tuple(tables)
+        self._scores = _sharded_jit(kind, placement.mesh(), grid,
+                                    block_shift=block_shift)
+        self.jit_fns = (self._scores,)
+
+    def dispatch(self, staged):
+        return self._scores(*self._tables, staged.indices, staged.values)
+
+
+class _ShardedLabelServable(_ShardedRowServable, _ArgmaxLabelServable):
+    """Multiclass on a mesh: sharded dispatch + the shared argmax/vocab
+    label selection."""
+
+    def __init__(self, kind: str, tables, dims: int, label_vocab,
+                 placement: ModelSharded, grid: tuple,
+                 block_shift: Optional[int] = None) -> None:
+        super().__init__(kind, "multiclass", tables, dims, placement, grid,
+                         block_shift=block_shift)
+        self.label_vocab = list(label_vocab)
+
+
+class _ShardedMFServable(_ShardedMixin, _PairServable):
+    """MF on a mesh: pair staging inherited; P/Q/Bu/Bi striped over their
+    own (users, items) grids; jitted sharded gather-dot (unlike the
+    host-numpy single-device MF servable, the gathers here ARE device
+    batch work — assembling rows across stripes is the point)."""
+
+    def __init__(self, tables, placement: ModelSharded, grid: tuple,
+                 use_bias: bool, block_shift: Optional[int] = None) -> None:
+        self._tables = tuple(tables)
+        self._scores = _sharded_jit("mf", placement.mesh(), grid,
+                                    block_shift=block_shift,
+                                    use_bias=use_bias)
+        self.jit_fns = (self._scores,)
+
+    def dispatch(self, staged):
+        u, i = staged
+        return self._scores(*self._tables, u, i)
+
+
+# --- the sharded load path ---------------------------------------------------
+
+
+def sharded_servable(source, placement: ModelSharded):
+    """Artifact | trained model -> sharded servable on ``placement``.
+
+    The load path: normalize the score tables to host arrays at their
+    serving dtype (serving.artifact.host_score_tables — the manifest dtype
+    pin applies there), derive each id-grid's stripe with the trainers'
+    own grid arithmetic (stripe_grid; int8 aligns the stripe to the scale
+    block), pad + place every striped table with NamedSharding along the
+    model axis and every scalar replicated, then bind the family's
+    process-shared sharded scorer. Budget checks run against the
+    PER-DEVICE resident bytes — the quantity sharding actually divides."""
+    spec = host_score_tables(source)
+    quant = spec["quant"]
+    scheme = quant["scheme"] if quant else None
+    from ..io.checkpoint import QUANT_SCHEME_INT8
+
+    is_int8 = scheme == QUANT_SCHEME_INT8
+    block_rows = int(quant["block_rows"]) if is_int8 else 1
+    block_shift = block_rows.bit_length() - 1 if is_int8 else None
+    n = placement.model_shards
+    mesh = placement.mesh()
+    meta = spec["meta"]
+
+    # one stripe grid per id space (features; users+items for MF)
+    grid_dims = {"features": int(meta["dims"]) if "dims" in meta else None,
+                 "users": int(meta.get("num_users", 0)),
+                 "items": int(meta.get("num_items", 0))}
+    grids = {}
+    for _, _, _, grid in spec["striped"]:
+        if grid not in grids:
+            stripe, padded = stripe_grid(grid_dims[grid], n,
+                                         align=block_rows)
+            grids[grid] = (grid_dims[grid], stripe, padded)
+
+    # budget BEFORE placement: per-device bytes are computable from host
+    # array shapes alone, and the whole point of the refusal is to fire
+    # before jax.device_put can OOM a real device
+    per_device = 0
+    for name, arr, axis, grid in spec["striped"]:
+        _, stripe, _ = grids[grid]
+        per_device += stripe * (arr.size // arr.shape[axis]) \
+            * arr.dtype.itemsize
+        scales = spec["scales"].get(name)
+        if scales is not None:
+            per_device += (stripe // block_rows) \
+                * (scales.size // scales.shape[axis]) * 4
+    for arr in spec["replicated"].values():
+        per_device += int(np.asarray(arr).size) * 4
+    placement.check_budget(
+        int(per_device), f"{spec['family']} model ({spec['weights_dtype']})")
+
+    placed = {}
+    for name, arr, axis, grid in spec["striped"]:
+        dims_g, stripe, padded = grids[grid]
+        placed[name] = _stripe_put(arr, axis, dims_g, padded, mesh)
+        scales = spec["scales"].get(name)
+        if scales is not None:
+            # scales stripe WITH their blocks: the block grid is the row
+            # grid divided by block_rows, and the stripe is block-aligned
+            nb = -(-dims_g // block_rows)
+            placed[name + "__scale"] = _stripe_put(
+                scales, axis, nb, padded // block_rows, mesh)
+    for name, arr in spec["replicated"].items():
+        placed[name] = _replicate_put(arr, mesh)
+
+    family = spec["family"]
+    if family == "linear":
+        grid = (grids["features"][1],)
+        if is_int8:
+            sv = _ShardedRowServable(
+                "q8_linear", "linear",
+                (placed["weights"], placed["weights__scale"]),
+                grid_dims["features"], placement, grid,
+                block_shift=block_shift)
+        else:
+            sv = _ShardedRowServable("linear", "linear",
+                                     (placed["weights"],),
+                                     grid_dims["features"], placement, grid)
+    elif family == "multiclass":
+        grid = (grids["features"][1],)
+        if is_int8:
+            sv = _ShardedLabelServable(
+                "q8_multiclass",
+                (placed["weights"], placed["weights__scale"]),
+                grid_dims["features"], meta["label_vocab"], placement, grid,
+                block_shift=block_shift)
+        else:
+            sv = _ShardedLabelServable(
+                "multiclass", (placed["weights"],), grid_dims["features"],
+                meta["label_vocab"], placement, grid)
+    elif family == "fm":
+        grid = (grids["features"][1],)
+        if is_int8:
+            sv = _ShardedRowServable(
+                "q8_fm", "fm",
+                (placed["w0"], placed["w"], placed["w__scale"],
+                 placed["v"], placed["v__scale"]),
+                grid_dims["features"], placement, grid,
+                block_shift=block_shift)
+        else:
+            sv = _ShardedRowServable(
+                "fm", "fm", (placed["w0"], placed["w"], placed["v"]),
+                grid_dims["features"], placement, grid)
+    else:  # mf
+        grid = (grids["users"][1], grids["items"][1])
+        tables = [placed["P"], placed["Q"], placed["Bu"], placed["Bi"],
+                  placed["mu"]]
+        if is_int8:
+            tables += [placed["P__scale"], placed["Q__scale"]]
+        else:
+            # the mf body takes a fixed arity; non-quant runs pass the bias
+            # tables again as inert stand-ins for the scale slots (never
+            # read: the body only touches them when block_shift is set)
+            tables += [placed["Bu"], placed["Bi"]]
+        sv = _ShardedMFServable(tables, placement, grid,
+                                bool(meta["use_bias"]),
+                                block_shift=block_shift)
+        sv.family = "mf"
+    sv.per_device_table_bytes = int(per_device)
+    sv._init_placement(placement, spec, grids)
+    sv.placement_info["per_device_table_bytes"] = int(per_device)
+    return sv

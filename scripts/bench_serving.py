@@ -50,6 +50,24 @@ evaluation/metrics.py. The int8-vs-f32 logloss delta is a HARD parity pin
 than the tolerance fails the run whether or not --smoke is set — speed
 that costs accuracy is a regression, not a win (docs/serving.md
 "Quantized artifacts").
+
+`--sharded` switches to the sharded-placement bench (docs/serving.md
+"Sharded serving"): ONE model served single-device and NamedSharding-
+striped over every (batch, model) mesh shape the host's devices admit,
+driven by interleaved paired trials over one shared pre-parsed pool —
+throughput/p50/p99 per placement with deltas vs single-device at EQUAL
+model, a hard score-parity pin across placements, and the
+models-bigger-than-one-device demonstration: under a simulated
+device_byte_budget the single-device load must REFUSE
+(ModelExceedsDeviceBudget) while the sharded placement serves the same
+artifact within budget. --smoke additionally gates zero steady-state
+recompiles on every placement (tier-1 gate in scripts/test.sh).
+
+Every mode records the ``device_set`` it actually measured on (platform,
+device count, device kinds, process count — plus the mesh shapes a
+sharded run used), the bench.py discipline since PR 6: a round that fell
+back to CPU or got fewer devices than expected stays attributable from
+the BENCH JSON alone.
 """
 
 from __future__ import annotations
@@ -75,6 +93,25 @@ from hivemall_tpu.serving import (DynamicBatcher, ServingEngine,  # noqa: E402
 # be attribution-grade (server root, queue wait, pad, device dispatch/block)
 REQUIRED_STAGES = {"server.predict", "queue.wait", "engine.pad",
                    "engine.dispatch", "engine.block"}
+
+
+def _device_set(extra=None):
+    """The device set this run ACTUALLY measured on — recorded in every
+    BENCH JSON line (the bench.py shape since PR 6) so a degraded round
+    (CPU fallback, fewer simulated devices than the gate expects) is
+    diagnosable from the artifact alone."""
+    import jax
+
+    ds = {
+        "platform": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "device_kinds": sorted({d.device_kind for d in jax.devices()}),
+    }
+    if extra:
+        ds.update(extra)
+    return ds
 
 
 def trace_report(trace_path):
@@ -324,6 +361,7 @@ def run_quantize_mode(args) -> int:
         "value": deltas["int8"]["throughput_x"],
         "unit": "x",
         "methodology": "interleaved_paired_trials_closed_loop_engine",
+        "device_set": _device_set(),
         "trials": int(args.quant_trials),
         "concurrency": int(args.concurrency),
         "requests_per_trial": len(pool),
@@ -348,6 +386,189 @@ def run_quantize_mode(args) -> int:
         print(f"PARITY FAIL: int8 logloss delta {int8_delta:.6f} / bf16 "
               f"{bf16_delta:.6f} vs tolerance {args.parity_tol_logloss}",
               file=sys.stderr)
+        return 1
+    if args.smoke and any(steady.values()):
+        print(f"SMOKE FAIL: steady_state_recompiles={steady}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_sharded_mode(args) -> int:
+    """Sharded-placement bench: single-device vs NamedSharding servables.
+
+    One AROW model (planted weights, pre-parsed pool — the quantize-bench
+    methodology) serves through a single-device engine and through a
+    model-sharded engine per admissible (batch, model) mesh shape; the
+    SAME pool drives every placement in interleaved paired trials. Hard
+    gates: sharded holdout scores must match single-device within
+    tolerance on every mesh (always), the simulated-budget demo must show
+    single-device REFUSING a model the sharded placement then serves, and
+    under --smoke every placement must sweep the whole bucket mesh with
+    zero steady-state recompiles.
+    """
+    import jax
+
+    from hivemall_tpu.models.classifier import train_arow
+    from hivemall_tpu.serving import (ModelExceedsDeviceBudget, ModelSharded,
+                                      ServingEngine, SingleDevice,
+                                      make_servable)
+
+    ndev = jax.device_count()
+    if ndev < 2:
+        print(f"SHARDED FAIL: needs >= 2 devices, have {ndev} "
+              f"(CPU runs force 8 via xla_force_host_platform_device_count)",
+              file=sys.stderr)
+        return 1
+    mesh_shapes = [(1, m) for m in (2, 4) if m <= ndev]
+    if ndev >= 4:
+        mesh_shapes.append((2, 2))
+
+    nnz = (4, 14) if args.smoke else (16, args.max_width + 1)
+    w_true = _planted_weights(args.dims)
+    train_rows, train_labels = _planted_rows(w_true, args.train_rows,
+                                             seed=7, nnz=nnz)
+    hold_rows, _ = _planted_rows(w_true, args.holdout, seed=99, nnz=nnz)
+    t0 = time.perf_counter()
+    model = train_arow(train_rows, train_labels, f"-dims {args.dims}")
+    train_s = time.perf_counter() - t0
+
+    def key_of(shape):
+        return "single" if shape is None else f"mesh_{shape[0]}x{shape[1]}"
+
+    placements = [None] + mesh_shapes
+    engines, warm = {}, {}
+    for shape in placements:
+        key = key_of(shape)
+        pl = None if shape is None else ModelSharded(shape[1],
+                                                     batch_shards=shape[0])
+        eng = ServingEngine(model, name=f"shard_{key}",
+                            max_batch=args.max_batch,
+                            max_width=args.max_width, placement=pl)
+        t0 = time.perf_counter()
+        compiles = eng.warmup()
+        warm[key] = {"compiles": int(compiles),
+                     "seconds": round(time.perf_counter() - t0, 3)}
+        engines[key] = eng
+
+    # score parity at EQUAL model: every placement must reproduce the
+    # single-device scores (same staged arrays, same stripe math as
+    # training — tests pin bit-identity on dyadic rows; random-valued
+    # rows leave only reduction-order rounding)
+    ref = np.asarray(engines["single"].predict(hold_rows), np.float32)
+    scale = float(np.max(np.abs(ref))) or 1.0
+    parity = {}
+    for shape in mesh_shapes:
+        out = np.asarray(engines[key_of(shape)].predict(hold_rows),
+                         np.float32)
+        parity[key_of(shape)] = float(np.max(np.abs(out - ref)) / scale)
+    parity_ok = all(v <= args.parity_tol_score for v in parity.values())
+
+    # interleaved paired trials over ONE shared pre-parsed pool
+    pool = _preparsed_pool(train_rows, args.requests,
+                           args.instances_per_request)
+    total_rows = sum(len(r[2]) for r in pool)
+    guards = {k: REGISTRY.counter("graftcheck",
+                                  f"recompiles.serving.shard_{k}")
+              for k in engines}
+    recompiles0 = {k: guards[k].value for k in engines}
+    keys = [key_of(s) for s in placements]
+    trials = {k: [] for k in keys}
+    lats = {k: [] for k in keys}
+    for t in range(args.quant_trials):
+        rot = t % len(keys)
+        for k in keys[rot:] + keys[:rot]:
+            wall, trial_lats = _drive_closed_loop(engines[k], pool,
+                                                  args.concurrency)
+            lats[k].extend(trial_lats)
+            trials[k].append(total_rows / wall)
+    steady = {k: int(guards[k].value - recompiles0[k]) for k in engines}
+
+    # the models-bigger-than-one-device demo: a budget below the table
+    # bytes must refuse single-device and serve sharded — per-device
+    # bytes are what sharding divides
+    budget = engines["single"].table_bytes // 2
+    max_shards = max(m for _, m in mesh_shapes)
+    budget_block = {"budget_bytes": int(budget),
+                    "table_bytes": int(engines["single"].table_bytes),
+                    "single_device_refused": False, "sharded_served": False}
+    try:
+        make_servable(model, placement=SingleDevice(
+            device_byte_budget=budget))
+    except ModelExceedsDeviceBudget:
+        budget_block["single_device_refused"] = True
+    try:
+        eng_b = ServingEngine(model, name="shard_budget",
+                              max_batch=args.max_batch,
+                              max_width=args.max_width,
+                              placement=ModelSharded(
+                                  max_shards, device_byte_budget=budget))
+        eng_b.warmup()
+        n_scored = len(eng_b.predict(hold_rows))
+        budget_block["sharded_served"] = n_scored == len(hold_rows[0])
+        budget_block["per_device_bytes"] = int(eng_b.per_device_table_bytes)
+        budget_block["model_shards"] = int(max_shards)
+    except ModelExceedsDeviceBudget as e:
+        budget_block["error"] = str(e)
+    budget_ok = (budget_block["single_device_refused"]
+                 and budget_block["sharded_served"])
+
+    pcts = {k: _percentiles(lats[k]) for k in keys}
+
+    def paired_ratio(k):
+        return float(np.median(np.asarray(trials[k])
+                               / np.asarray(trials["single"])))
+
+    placements_block = {
+        k: {
+            "throughput_rows_per_sec": round(float(np.median(trials[k])), 1),
+            "p50_ms": round(pcts[k][50], 3),
+            "p99_ms": round(pcts[k][99], 3),
+            "steady_state_recompiles": steady[k],
+            "warmup": warm[k],
+            "placement": engines[k].placement,
+            "per_device_table_bytes": int(engines[k].per_device_table_bytes),
+        } for k in keys
+    }
+    deltas = {
+        k: {
+            "throughput_x": round(paired_ratio(k), 3),
+            "p50_ms": round(pcts[k][50] - pcts["single"][50], 3),
+            "p99_ms": round(pcts[k][99] - pcts["single"][99], 3),
+            "max_rel_score_delta": parity[k],
+        } for k in keys if k != "single"
+    }
+    best = max(deltas, key=lambda k: deltas[k]["throughput_x"])
+    result = {
+        "metric": f"serving_sharded_throughput_vs_single_arow_"
+                  f"{args.dims}dims",
+        "value": deltas[best]["throughput_x"],
+        "unit": "x",
+        "methodology": "interleaved_paired_trials_closed_loop_engine",
+        "device_set": _device_set(
+            {"mesh_shapes": [list(s) for s in mesh_shapes]}),
+        "trials": int(args.quant_trials),
+        "concurrency": int(args.concurrency),
+        "requests_per_trial": len(pool),
+        "rows_per_trial": int(total_rows),
+        "train": {"rows": len(train_rows[0]), "seconds": round(train_s, 3)},
+        "holdout_rows": len(hold_rows[0]),
+        "best_mesh": best,
+        "placements": placements_block,
+        "deltas_vs_single": deltas,
+        "exceeds_single_device": budget_block,
+        "parity": {"tolerance_rel_score": args.parity_tol_score,
+                   "max_rel_score_delta": max(parity.values()),
+                   "ok": parity_ok},
+    }
+    print(json.dumps(result))
+
+    if not parity_ok:
+        print(f"PARITY FAIL: sharded scores drift {parity} past "
+              f"{args.parity_tol_score} of single-device", file=sys.stderr)
+        return 1
+    if not budget_ok:
+        print(f"BUDGET FAIL: {budget_block}", file=sys.stderr)
         return 1
     if args.smoke and any(steady.values()):
         print(f"SMOKE FAIL: steady_state_recompiles={steady}",
@@ -591,6 +812,7 @@ def run_http_mode(args, source, rows, tag) -> int:
         "value": round(len(lat) / wall, 1) if wall else 0.0,
         "unit": "req/s",
         "methodology": "http_post_predict_closed_loop",
+        "device_set": _device_set(),
         "steady_state_recompiles": int(steady_recompiles),
         "warmup": {"compiles": warm_compiles,
                    "seconds": round(warm_s, 3)},
@@ -659,8 +881,17 @@ def main() -> int:
                          "frozen model (freeze(quantize=...)); hard-fails "
                          "when int8 holdout logloss drifts past "
                          "--parity-tol-logloss")
+    ap.add_argument("--sharded", action="store_true",
+                    help="sharded-placement bench: single-device vs "
+                         "NamedSharding servables per (batch, model) mesh "
+                         "shape at equal model, plus the simulated-budget "
+                         "model-only-fits-sharded demo; hard-fails on "
+                         "score-parity drift past --parity-tol-score")
+    ap.add_argument("--parity-tol-score", type=float, default=1e-4,
+                    help="max |sharded - single| / max|single| holdout "
+                         "score drift a placement may show (hard gate)")
     ap.add_argument("--quant-trials", type=int, default=None,
-                    help="paired trials per precision; default 5 "
+                    help="paired trials per precision/placement; default 5 "
                          "(3 under --smoke)")
     ap.add_argument("--holdout", type=int, default=None,
                     help="holdout rows for the logloss/AUC parity pin; "
@@ -682,6 +913,17 @@ def main() -> int:
               "max_width": (64, 32), "instances_per_request": (8, 8),
               "quant_trials": (5, 3),
               "holdout": (4000, 300)}
+    if args.sharded:
+        # the sharded bench sizes for a table worth striping: 2^22-dim f32
+        # (16 MB) full-scale so per-device slices actually differ, tiny
+        # under --smoke where the subject is the invariants (parity, zero
+        # recompiles, the budget refusal), not bandwidth
+        sizing.update({"dims": (1 << 22, 1 << 12),
+                       "train_rows": (50000, 300),
+                       "requests": (800, 120),
+                       "concurrency": (0, 2),
+                       "max_batch": (1024, 64),
+                       "instances_per_request": (512, 16)})
     if args.quantize:
         # the quantized bench sizes for table-bandwidth sensitivity: a
         # 2^24-dim f32 weight table (64 MB) is past any cache this host
@@ -702,6 +944,27 @@ def main() -> int:
     for name, (full, small) in sizing.items():
         if getattr(args, name) is None:
             setattr(args, name, small if args.smoke else full)
+
+    if args.sharded:
+        if args.artifact or args.http or args.quantize:
+            raise SystemExit("--sharded trains and places its own model; "
+                             "it does not compose with --artifact, --http "
+                             "or --quantize")
+        import os
+
+        # CPU runs simulate a mesh the same way the test suite does
+        # (tests/conftest.py): force 8 host devices BEFORE jax initializes
+        # (re-exec, the --quantize pattern). Real accelerator runs keep
+        # their native device set.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+                and "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        if not args.concurrency:  # 0 from sizing: drivers match cores
+            args.concurrency = min(8, os.cpu_count() or 2)
+        return run_sharded_mode(args)
 
     if args.quantize:
         if args.artifact or args.http:
@@ -792,6 +1055,7 @@ def main() -> int:
         "value": round(len(closed_lat) / closed_wall, 1),
         "unit": "req/s",
         "methodology": "in_process_batcher_closed_loop",
+        "device_set": _device_set(),
         "steady_state_recompiles": int(steady_recompiles),
         "warmup": {"compiles": int(warm_compiles),
                    "seconds": round(warm_s, 3),

@@ -46,4 +46,14 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_chaos.py --smoke
 
+# tier-1 gate 6: sharded-serving smoke — one model served single-device
+# and NamedSharding-striped over every admissible (batch, model) mesh
+# shape: sharded scores must match single-device at equal model, every
+# placement must show zero steady-state recompiles, and an artifact
+# exceeding the simulated single-device byte budget must refuse
+# single-device but serve sharded (docs/serving.md "Sharded serving";
+# prints one BENCH-style JSON line)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_serving.py --sharded --smoke
+
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

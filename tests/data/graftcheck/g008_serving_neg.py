@@ -1,0 +1,48 @@
+"""G008 serving negative fixture: the sharded load-path pattern done
+right — every PartitionSpec axis bound by the (batch, model) serving mesh
+(serving/placement.py convention), dynamic specs and parameter meshes
+trusted — zero findings."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from hivemall_tpu.runtime.jax_compat import named_mesh, shard_map
+
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+
+def local_score(w, idx, val):
+    return jax.lax.psum(jnp.sum(w * val, axis=-1), MODEL_AXIS)
+
+
+def make_sharded_scores():
+    # default axis names: ("batch", "model")
+    mesh = named_mesh((1, 2))
+    return shard_map(local_score, mesh=mesh,
+                     in_specs=(P(MODEL_AXIS), P(BATCH_AXIS), P(BATCH_AXIS)),
+                     out_specs=P(BATCH_AXIS))
+
+
+def place_striped(table):
+    mesh = named_mesh((1, 4), ("batch", "model"))
+    spec = [None, MODEL_AXIS]  # striped along axis 1, e.g. [L, D] weights
+    return jax.device_put(table, NamedSharding(mesh, P(*spec)))
+
+
+def place_replicated(x):
+    mesh = named_mesh((2, 2), axis_names=("batch", "model"))
+    return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def place_param_mesh(x, mesh):
+    # mesh is a parameter: unknown, trusted (the sharded servable builders
+    # receive their placement's mesh this way)
+    return jax.device_put(x, NamedSharding(mesh, P(MODEL_AXIS)))
+
+
+def custom_axes(x):
+    mesh = named_mesh((2, 2), ("rows", "cols"))
+    return jax.device_put(x, NamedSharding(mesh, P("rows")))

@@ -1,0 +1,43 @@
+"""G008 serving positive fixture: PartitionSpec axes the (batch, model)
+SERVING mesh does not bind — the sharded load-path mistakes the rule must
+catch (a training-axis spec against a serving mesh, and a typo'd axis in a
+NamedSharding placement)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from hivemall_tpu.runtime.jax_compat import named_mesh, shard_map
+
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+
+def local_score(w, idx, val):
+    return jax.lax.psum(jnp.sum(w * val, axis=-1), MODEL_AXIS)
+
+
+def make_sharded_scores():
+    # serving mesh binds (batch, model); "workers" is a TRAINING axis
+    mesh = named_mesh((1, 2))
+    return shard_map(local_score, mesh=mesh,
+                     in_specs=(P("workers"), P(BATCH_AXIS),  # EXPECT: G008
+                               P(BATCH_AXIS)),
+                     out_specs=P(BATCH_AXIS))
+
+
+def place_striped(table):
+    # typo'd axis: the mesh binds "model", not "shards"
+    mesh = named_mesh((1, 4), ("batch", "model"))
+    return jax.device_put(table, NamedSharding(mesh, P("shards")))  # EXPECT: G008
+
+
+def place_batch_only(x):
+    mesh = named_mesh((2, 2), axis_names=("batch", "model"))
+    return jax.device_put(x, NamedSharding(mesh, P("replica")))  # EXPECT: G008
+
+
+def stage(instances):
+    return np.asarray(instances, np.float32)

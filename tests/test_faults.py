@@ -404,3 +404,61 @@ def test_manifest_is_json_with_striping_metadata(tmp_path, w_true):
     assert manifest["block_step"] == 1
     assert manifest["format_version"] == 1
     json.dumps(manifest)  # fully JSON-able end to end
+
+
+def test_run_elastic_sigterm_checkpoints_before_exit(tmp_path, w_true):
+    """Preemption-aware checkpointing: a SIGTERM mid-run must checkpoint
+    the completed step IMMEDIATELY (not at the next cadence boundary) and
+    return early with the preemption recorded — and a fresh run_elastic
+    must resume from exactly that step. The signal is raised in-process
+    from the data stream (a raised-signal fake: the handler runs at the
+    next bytecode boundary, i.e. while step 5's block is being built)."""
+    import signal
+
+    import jax
+
+    from hivemall_tpu.runtime.recovery import peek_manifest, run_elastic
+
+    path = str(tmp_path / "ck.npz")
+    handler_before = signal.getsignal(signal.SIGTERM)
+
+    def data_fn(trainer, i):
+        if i == 5:
+            signal.raise_signal(signal.SIGTERM)
+        return _blk(i, w_true)
+
+    trainer, state, report = run_elastic(
+        _make_trainer_factory(path), data_fn, 12, path,
+        checkpoint_every=100,  # cadence would never fire in 12 steps
+        devices=list(jax.devices())[:4])
+    assert report["preempted"] is True
+    assert report["preempted_at_step"] == 6  # step 5 completed, then exit
+    assert report["restarts"] == 0
+    assert report["checkpoints_written"] == 1
+    manifest = peek_manifest(path)
+    assert manifest is not None and manifest["block_step"] == 6
+    # the previous handler is restored after the run
+    assert signal.getsignal(signal.SIGTERM) is handler_before
+
+    # a fresh run resumes at the preempted step and finishes the stream
+    trainer, state, report2 = run_elastic(
+        _make_trainer_factory(path), lambda t, i: _blk(i, w_true), 12, path,
+        checkpoint_every=100, devices=list(jax.devices())[:4])
+    assert report2["preempted"] is False
+    assert peek_manifest(path)["block_step"] == 12
+    # every example counted exactly once across the preemption boundary
+    final = trainer.final_state(state)
+    assert int(final.step) == 12 * 16
+
+
+def test_run_elastic_without_sigterm_reports_unpreempted(tmp_path, w_true):
+    import jax
+
+    from hivemall_tpu.runtime.recovery import run_elastic
+
+    path = str(tmp_path / "ck.npz")
+    _, _, report = run_elastic(
+        _make_trainer_factory(path), lambda t, i: _blk(i, w_true), 4, path,
+        checkpoint_every=2, devices=list(jax.devices())[:2])
+    assert report["preempted"] is False
+    assert "preempted_at_step" not in report

@@ -363,6 +363,33 @@ def test_fixer_round_trip_g018_f64(tmp_path):
     assert target.read_text() == fixed
 
 
+def test_g008_serving_fixtures():
+    """G008's scope extends to the serving mesh convention: the analyzer
+    resolves ``runtime.jax_compat.named_mesh`` sites to their axis-name
+    set (default ``("batch", "model")``), so a training-axis spec or a
+    typo'd axis on the sharded serving load path is a finding, and the
+    correct NamedSharding/shard_map placement pattern is clean."""
+    pos = os.path.join(DATA, "g008_serving_pos.py")
+    expected = _expected(pos)
+    assert expected, f"{pos} must declare EXPECT markers"
+    found = sorted((f.line, f.rule) for f in analyze_paths([pos]))
+    assert found == expected, (
+        f"serving G008 positives mismatch:\nexpected {expected}\n"
+        f"found    {found}")
+    neg = analyze_paths([os.path.join(DATA, "g008_serving_neg.py")])
+    assert neg == [], "\n".join(f.format() for f in neg)
+
+
+def test_serving_sharded_load_path_is_spec_mesh_clean():
+    """The REAL sharded serving tree (placement.py, sharded.py, engine.py
+    and friends) carries zero G008 findings — every PartitionSpec axis on
+    the load path is bound by its mesh, pinned so a future axis typo or a
+    training-axis leak into serving fails tier-1."""
+    hits = [f for f in analyze_paths([os.path.join(PKG, "serving")])
+            if f.rule == "G008"]
+    assert hits == [], "\n".join(f.format() for f in hits)
+
+
 def test_ops_and_serving_are_dtype_clean():
     """Acceptance (v4): the dogfooded hot-path and serving/IO modules carry
     ZERO non-baselined G017-G021 findings — the engine.py f64 request

@@ -126,8 +126,11 @@ def test_quantized_serving_zero_recompiles(model, tmp_path):
 
 def test_warmup_dummy_construction_is_deduped():
     """Warmup dedup satellite: dummy instances are keyed by bucket shape
-    and shared — warming a second same-family engine over the same bucket
-    mesh must not re-construct a single dummy row."""
+    AND mesh shape. A second same-family engine on the same mesh (here:
+    no mesh, single-device) constructs zero dummies; an engine on a
+    DIFFERENT mesh shape must NOT false-hit the cache — its warmup sweep
+    fills per-mesh jit caches, so its dummy keys are per-mesh too."""
+    from hivemall_tpu.serving import ModelSharded
     from hivemall_tpu.serving import engine as eng_mod
 
     m = train_arow(ROWS, LABELS, "-dims 256")
@@ -152,6 +155,44 @@ def test_warmup_dummy_construction_is_deduped():
     # and the second engine still warmed its full bucket mesh
     assert len(e2.warmed_buckets) == \
         len(e2.batch_buckets()) * len(e2.width_buckets())
+
+    # a sharded engine has a different mesh shape: (1, 2) must construct
+    # its own dummies (no false hit on the single-device keys), then a
+    # SECOND (1, 2) engine must hit that cache, and a (1, 4) engine must
+    # miss again — same family, same widths, different mesh. Evict any
+    # mesh-keyed entries earlier tests left so the miss/hit sequence is
+    # order-independent.
+    for key in [k for k in eng_mod._WARMUP_DUMMIES
+                if k[-1] in ((1, 2), (1, 4))]:
+        del eng_mod._WARMUP_DUMMIES[key]
+
+    def sharded_engine(name, shards):
+        return ServingEngine(m, name=name, max_batch=32, max_width=16,
+                             placement=ModelSharded(shards))
+
+    s1 = sharded_engine("dedup_mesh_a", 2)
+    sv2 = s1.servable
+    calls2 = []
+    orig2 = type(sv2).dummy_instance
+
+    def spy2(self, width):
+        calls2.append((self.mesh_shape, width))
+        return orig2(self, width)
+
+    type(sv2).dummy_instance = spy2
+    try:
+        s1.warmup()
+        first = list(calls2)
+        assert first, "a new mesh shape must not false-hit the dummy cache"
+        sharded_engine("dedup_mesh_b", 2).warmup()
+        assert calls2 == first, \
+            f"second engine on the SAME mesh re-constructed: {calls2[len(first):]}"
+        sharded_engine("dedup_mesh_c", 4).warmup()
+        assert len(calls2) == 2 * len(first), \
+            "a different mesh shape must key its own dummies"
+        assert {k[0] for k in calls2} == {(1, 2), (1, 4)}
+    finally:
+        type(sv2).dummy_instance = orig2
 
 
 def test_preparsed_requests_match_string_requests(model):

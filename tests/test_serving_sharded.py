@@ -1,0 +1,361 @@
+"""Sharded serving (serving/placement.py + serving/sharded.py): per-family
+parity pins against the single-device scorer on multiple mesh shapes,
+quantized (bf16/int8) striping, the zero-steady-state-recompile contract,
+the simulated device-byte-budget refusal, and the /models placement block.
+
+Bit-identity discipline: linear and multiclass pins use rows of <= 2
+non-zeros with dyadic values (1.0 / 0.5). Each per-row reduction then
+performs at most ONE rounding addition of two arbitrary f32 products —
+identical under any grouping — so splitting the sum across stripes and
+psum-ing the partials reproduces the single-device bits exactly. Wider
+rows regroup >= 3 arbitrary-float additions across devices, where IEEE
+addition is not associative; those pin allclose instead (same contract
+the FM/MF families get, whose reductions are wide by construction)."""
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.models.classifier import train_arow
+from hivemall_tpu.runtime.metrics import REGISTRY, recompile_guard
+from hivemall_tpu.serving import (ModelExceedsDeviceBudget, ModelSharded,
+                                  Replicated, ServingEngine, SingleDevice,
+                                  freeze, load, make_servable)
+
+DIMS = 256
+ROWS = [[f"{i % 13}:1.0", f"{(i * 7) % 13}:0.5"] for i in range(64)]
+LABELS = [1 if i % 2 else -1 for i in range(64)]
+
+# >= 2 mesh shapes (acceptance): pure model sharding and batch x model
+MESHES = [(1, 2), (2, 2), (1, 4)]
+
+
+def mesh_ids(shape):
+    return f"{shape[0]}x{shape[1]}"
+
+
+@pytest.fixture(scope="module")
+def linear_model():
+    return train_arow(ROWS, LABELS, f"-dims {DIMS}")
+
+
+@pytest.fixture(scope="module")
+def mc_model():
+    from hivemall_tpu.models.multiclass import train_multiclass_pa
+
+    rows = [[f"{i % 11}:1.0", f"{(i * 5) % 11}:0.5"] for i in range(60)]
+    labels = [("a", "b", "c")[i % 3] for i in range(60)]
+    return train_multiclass_pa(rows, labels, "-dims 128"), rows
+
+
+@pytest.fixture(scope="module")
+def fm_model():
+    from hivemall_tpu.models.fm import train_fm
+
+    rows = [[f"{i % 17}:1.0", f"{(i * 3) % 17}:0.5"] for i in range(80)]
+    labels = [1.0 if i % 2 else -1.0 for i in range(80)]
+    return train_fm(rows, labels, "-dims 64 -factor 4"), rows
+
+
+@pytest.fixture(scope="module")
+def mf_model():
+    from hivemall_tpu.models.mf import train_mf_sgd
+
+    users = [i % 5 for i in range(40)]
+    items = [(i * 3) % 7 for i in range(40)]
+    ratings = [float((i % 5) + 1) for i in range(40)]
+    m = train_mf_sgd(users, items, ratings)
+    return m, list(zip(users[:12], items[:12]))
+
+
+def _engines(source, name, shape, **kw):
+    kw.setdefault("max_batch", 16)
+    kw.setdefault("max_width", 8)
+    ref = ServingEngine(source, name=f"{name}_sd", **kw)
+    eng = ServingEngine(source, name=f"{name}_{mesh_ids(shape)}",
+                        placement=ModelSharded(shape[1],
+                                               batch_shards=shape[0]), **kw)
+    return ref, eng
+
+
+# --- per-family parity on >= 2 mesh shapes -----------------------------------
+
+
+@pytest.mark.parametrize("shape", MESHES, ids=mesh_ids)
+def test_linear_sharded_bit_identical(linear_model, shape):
+    ref, eng = _engines(linear_model, "shl", shape)
+    out = np.asarray(eng.predict(ROWS))
+    assert np.array_equal(out, np.asarray(ref.predict(ROWS)))
+    # and matches the live model itself (the single-device pin transits)
+    assert np.array_equal(out, np.asarray(linear_model.predict(ROWS)))
+
+
+@pytest.mark.parametrize("shape", MESHES[:2], ids=mesh_ids)
+def test_multiclass_sharded_bit_identical(mc_model, shape):
+    model, rows = mc_model
+    ref, eng = _engines(model, "shmc", shape)
+    assert eng.predict(rows) == ref.predict(rows)  # labels
+    # raw [B, L] scores, bit-exact (dyadic 2-nnz rows — see module doc)
+    staged_ref = ref.servable.run_padded(rows[:8], 8, 8)
+    staged_sh = eng.servable.run_padded(rows[:8], 8, 8)
+    assert np.array_equal(np.asarray(staged_ref), np.asarray(staged_sh))
+
+
+@pytest.mark.parametrize("shape", MESHES[:2], ids=mesh_ids)
+def test_fm_sharded_parity(fm_model, shape):
+    model, rows = fm_model
+    ref, eng = _engines(model, "shfm", shape)
+    out = np.asarray(eng.predict(rows))
+    np.testing.assert_allclose(out, np.asarray(ref.predict(rows)),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("shape", MESHES[:2], ids=mesh_ids)
+def test_mf_sharded_parity(mf_model, shape):
+    model, pairs = mf_model
+    ref, eng = _engines(model, "shmf", shape)
+    out = np.asarray(eng.predict(pairs))
+    np.testing.assert_allclose(out, np.asarray(ref.predict(pairs)),
+                               rtol=1e-5, atol=1e-6)
+    # the inert scale stand-ins (Bu/Bi passed twice to the fixed-arity
+    # body) must not double-count in table_bytes: P, Q, Bu, Bi, mu
+    assert len(eng.servable.device_tables()) == 5
+
+
+def test_linear_sharded_wide_rows_allclose(linear_model):
+    """Wide rows regroup >= 3 additions across stripes — allclose, and the
+    engine's truncation/bucketing behavior is identical to single-device
+    (same staged arrays feed both)."""
+    wide = [[f"{(i * 3 + k) % DIMS}:0.75" for k in range(7)]
+            for i in range(40)]
+    ref, eng = _engines(linear_model, "shw", (1, 4), max_width=8)
+    np.testing.assert_allclose(np.asarray(eng.predict(wide)),
+                               np.asarray(ref.predict(wide)),
+                               rtol=1e-5, atol=1e-6)
+
+
+# --- quantized striping ------------------------------------------------------
+
+
+@pytest.mark.parametrize("quant", ["bf16", "int8"])
+@pytest.mark.parametrize("shape", MESHES[:2], ids=mesh_ids)
+def test_quantized_linear_sharded_bit_identical(linear_model, tmp_path,
+                                                quant, shape):
+    """bf16 tables stripe AT bf16, int8 tables stripe with their scale
+    arrays on the block grid — and reproduce the single-device quantized
+    scorer bit-for-bit (same gathered windows, same per-window widen)."""
+    path = str(tmp_path / quant)
+    freeze(linear_model, path, name=f"shq_{quant}", version="1",
+           quantize=quant)
+    ref, eng = _engines(load(path), f"shq_{quant}", shape)
+    assert eng.weights_dtype == ("bfloat16" if quant == "bf16" else "int8")
+    out = np.asarray(eng.predict(ROWS))
+    assert np.array_equal(out, np.asarray(ref.predict(ROWS)))
+
+
+@pytest.mark.parametrize("quant", ["bf16", "int8"])
+def test_quantized_mc_fm_mf_sharded_parity(mc_model, fm_model, mf_model,
+                                           tmp_path, quant):
+    mc, mc_rows = mc_model
+    fm, fm_rows = fm_model
+    mf, pairs = mf_model
+    for tag, model, req, exact in (("mc", mc, mc_rows, True),
+                                   ("fm", fm, fm_rows, False),
+                                   ("mf", mf, pairs, False)):
+        path = str(tmp_path / f"{tag}_{quant}")
+        freeze(model, path, name=f"shq_{tag}", version="1", quantize=quant)
+        ref, eng = _engines(load(path), f"shq_{tag}_{quant}", (1, 2))
+        out, want = eng.predict(req), ref.predict(req)
+        if exact:
+            assert out == want  # multiclass labels
+        else:
+            np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_int8_scale_blocks_never_straddle_stripes(linear_model, tmp_path):
+    """A custom block_rows that does not divide ceil(dims/n) forces the
+    stripe to ALIGN UP (stripe_grid's align), so every scale block lives
+    on exactly one device — pinned via the stripe grid the servable
+    reports and by score parity."""
+    path = str(tmp_path / "int8_block")
+    freeze(linear_model, path, name="shq_block", version="1",
+           quantize="int8", quant_block_rows=32)
+    ref, eng = _engines(load(path), "shq_block", (1, 4))
+    grid = eng.placement["stripe_grids"]["features"]
+    assert grid["stripe"] % 32 == 0
+    assert grid["dims_padded"] == grid["stripe"] * 4
+    assert np.array_equal(np.asarray(eng.predict(ROWS)),
+                          np.asarray(ref.predict(ROWS)))
+
+
+# --- striping arithmetic shared with training --------------------------------
+
+
+def test_stripe_grid_matches_trainer_arithmetic():
+    """The serving load path and the sharded trainers must derive the SAME
+    grid: stripe = ceil(dims / n), dims_padded = stripe * n
+    (parallel/sharded_train.py), with align rounding the stripe up."""
+    from hivemall_tpu.core.striping import stripe_grid
+
+    assert stripe_grid(256, 4) == (64, 256)
+    assert stripe_grid(1000, 4) == (250, 1000)  # trainer: -(-1000 // 4)
+    assert stripe_grid(131, 4) == (33, 132)     # non-divisible pads up
+    assert stripe_grid(131, 4, align=32) == (64, 256)  # block-aligned
+    assert stripe_grid(7, 1) == (7, 7)
+    with pytest.raises(ValueError):
+        stripe_grid(16, 0)
+
+
+def test_non_divisible_dims_bit_identical():
+    """dims = 300 over 4 stripes pads to 304 — the padded slots gather
+    only from pad lanes (value 0), so scores stay bit-identical."""
+    m = train_arow(ROWS, LABELS, "-dims 300")
+    ref, eng = _engines(m, "shnd", (1, 4))
+    grid = eng.placement["stripe_grids"]["features"]
+    assert grid == {"dims": 300, "stripe": 75, "dims_padded": 300}
+    assert np.array_equal(np.asarray(eng.predict(ROWS)),
+                          np.asarray(ref.predict(ROWS)))
+
+
+def test_preparsed_requests_through_sharded_engine(linear_model):
+    """The pre-parsed request forms (2-tuple and flat 3-tuple) stage
+    identically through a sharded engine."""
+    from hivemall_tpu.models.base import _stage_rows
+
+    _, eng = _engines(linear_model, "shpre", (1, 2))
+    ref = np.asarray(eng.predict(ROWS))
+    pre = _stage_rows(ROWS, DIMS)
+    assert np.array_equal(np.asarray(eng.predict(pre)), ref)
+    lens = np.array([len(r) for r in pre[0]], np.int64)
+    flat = (np.concatenate(pre[0]), np.concatenate(pre[1]), lens)
+    assert np.array_equal(np.asarray(eng.predict(flat)), ref)
+
+
+# --- warmup / recompile contract ---------------------------------------------
+
+
+def test_sharded_zero_steady_state_recompiles(linear_model):
+    """The f32 zero-recompile pin on a (batch, model) mesh: warmup sweeps
+    every (batch, width) bucket, then a sweep of every bucket combination
+    stays compile-free — witnessed by recompile_guard."""
+    eng = ServingEngine(linear_model, name="sh_warm", max_batch=32,
+                        max_width=16, placement=ModelSharded(2))
+    eng.warmup()
+    assert len(eng.warmed_buckets) == \
+        len(eng.batch_buckets()) * len(eng.width_buckets())
+    assert eng.warmup() == 0  # idempotent
+
+    counter = REGISTRY.counter("graftcheck", "recompiles.serving.sh_warm")
+    before = counter.value
+    with recompile_guard("sh_warm_sweep", *eng.servable.jit_fns,
+                         expect_stable=True):
+        for n in (1, 7, 8, 9, 16, 30, 32):
+            for width in (1, 5, 8, 13, 16):
+                batch = [[f"{k % 13}:1.0" for k in range(width)]
+                         for _ in range(n)]
+                assert len(eng.predict(batch)) == n
+    assert counter.value == before, "steady-state sharded serving recompiled"
+
+
+def test_sharded_jit_cache_is_shared_across_engines(linear_model):
+    """A second engine on the SAME mesh shape (a fresh Placement object —
+    same device list) reuses the process-shared sharded scorers: its
+    warmup compiles nothing."""
+    a = ServingEngine(linear_model, name="sh_share_a", max_batch=16,
+                      max_width=8, placement=ModelSharded(2))
+    a.warmup()
+    b = ServingEngine(linear_model, name="sh_share_b", max_batch=16,
+                      max_width=8, placement=ModelSharded(2))
+    assert b.warmup() == 0
+
+
+# --- placement surface / validation ------------------------------------------
+
+
+def test_replicated_placement_parity(linear_model):
+    ref = ServingEngine(linear_model, name="repl_sd", max_batch=16,
+                        max_width=8)
+    eng = ServingEngine(linear_model, name="repl", max_batch=16,
+                        max_width=8, placement=Replicated(batch_shards=8))
+    assert eng.placement["kind"] == "replicated"
+    assert eng.placement["model_shards"] == 1
+    assert np.array_equal(np.asarray(eng.predict(ROWS)),
+                          np.asarray(ref.predict(ROWS)))
+
+
+def test_batch_shards_must_divide_buckets(linear_model):
+    with pytest.raises(ValueError, match="batch_shards"):
+        ServingEngine(linear_model, name="sh_bad_bs", max_batch=16,
+                      max_width=8, min_batch_bucket=2,
+                      placement=ModelSharded(2, batch_shards=4))
+    with pytest.raises(ValueError, match="power of two"):
+        ModelSharded(2, batch_shards=3)
+
+
+def test_placement_string_resolution(linear_model):
+    eng = ServingEngine(linear_model, name="sh_str", max_batch=16,
+                        max_width=8, placement="model_sharded")
+    assert eng.placement["kind"] == "model_sharded"
+    assert eng.placement["model_shards"] >= 2
+    with pytest.raises(ValueError, match="unknown placement"):
+        make_servable(linear_model, placement="interleaved")
+
+
+def test_unshardable_family_refuses(tmp_path):
+    from hivemall_tpu.models.ffm import train_ffm
+
+    rows = [[f"{i % 3}:{i % 11}:1.0", f"{(i + 1) % 3}:{(i * 5) % 11}:0.5"]
+            for i in range(30)]
+    m = train_ffm(rows, [1 if i % 2 else -1 for i in range(30)],
+                  "-feature_hashing 8 -v_bits 10 -factor 2")
+    with pytest.raises(ValueError, match="no sharded serving path"):
+        make_servable(m, placement=ModelSharded(2))
+
+
+def test_device_byte_budget_enforced(linear_model):
+    """The models-bigger-than-one-device contract: a budget below the
+    table bytes refuses single-device, the sharded placement's per-device
+    slice fits and serves, and a budget below even the slice refuses
+    sharded too."""
+    total = ServingEngine(linear_model, name="bud_probe", max_batch=16,
+                          max_width=8).table_bytes
+    budget = total // 2
+    with pytest.raises(ModelExceedsDeviceBudget):
+        make_servable(linear_model,
+                      placement=SingleDevice(device_byte_budget=budget))
+    eng = ServingEngine(
+        linear_model, name="bud_ok", max_batch=16, max_width=8,
+        placement=ModelSharded(4, device_byte_budget=budget))
+    assert eng.per_device_table_bytes <= budget
+    assert len(eng.predict(ROWS)) == len(ROWS)
+    with pytest.raises(ModelExceedsDeviceBudget):
+        make_servable(linear_model, placement=ModelSharded(
+            4, device_byte_budget=total // 64))
+
+
+def test_registry_models_surface_placement(linear_model):
+    """ModelRegistry.deploy passes placement through engine kwargs and
+    /models (describe) carries the placement block — mesh shape, stripe
+    grids, per-device bytes — next to weights_dtype/table_bytes."""
+    from hivemall_tpu.serving import ModelRegistry
+
+    registry = ModelRegistry(max_batch=16,
+                             engine_kwargs={"max_width": 8})
+    registry.deploy("sharded_ctr", linear_model,
+                    placement=ModelSharded(2))
+    registry.deploy("plain_ctr", linear_model)
+    try:
+        by_name = {d["name"]: d for d in registry.list_models()}
+        pl = by_name["sharded_ctr"]["placement"]
+        assert pl["kind"] == "model_sharded"
+        assert pl["mesh_shape"] == [1, 2]
+        assert pl["stripe_grids"]["features"]["stripe"] == DIMS // 2
+        assert pl["per_device_table_bytes"] > 0
+        assert by_name["plain_ctr"]["placement"]["kind"] == "single_device"
+        # scores through the registry path match the direct engine
+        entry, fut = registry.submit("sharded_ctr", ROWS[:4])
+        assert np.array_equal(
+            np.asarray(fut.result(timeout=30)),
+            np.asarray(linear_model.predict(ROWS[:4])))
+    finally:
+        registry.shutdown()
