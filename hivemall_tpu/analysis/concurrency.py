@@ -100,8 +100,8 @@ class ClassConc:
     """Concurrency summary of one class."""
 
     __slots__ = ("path", "node", "name", "locks", "methods", "spawn_targets",
-                 "thread_side", "raw", "contexts", "eff_accesses",
-                 "eff_calls", "double_acquires")
+                 "thread_side", "thread_entries", "raw", "contexts",
+                 "eff_accesses", "eff_calls", "double_acquires")
 
     def __init__(self, path: str, node: ast.ClassDef):
         self.path = path
@@ -112,6 +112,14 @@ class ClassConc:
             n.name: n for n in node.body if isinstance(n, _FN_TYPES)}
         self.spawn_targets: Set[str] = set()
         self.thread_side: Set[str] = set()
+        # DIRECT thread entry points only (spawn targets + do_* handlers):
+        # the zero-held propagation seeds. thread_side is the CLOSURE over
+        # the call graph — right for "which methods run on the worker
+        # thread" (G012 cross-thread proof) but wrong as a held-set seed:
+        # a helper reached only via `with self._lock:` call sites would be
+        # falsely analyzed lock-free (its real contexts flow through the
+        # caller's held set).
+        self.thread_entries: Set[str] = set()
         self.raw: Dict[str, _Events] = {}
         # method -> {held-at-entry: introducing call node (None for entries)}
         self.contexts: Dict[str, Dict[FrozenSet[str],
@@ -244,6 +252,7 @@ class ConcurrencyModel:
                     cls.spawn_targets.add(attr)
 
     def _close_thread_side(self, cls: ClassConc) -> None:
+        cls.thread_entries = set(cls.thread_side) | cls.spawn_targets
         cls.thread_side |= cls.spawn_targets
         changed = True
         while changed:
@@ -386,8 +395,12 @@ class ConcurrencyModel:
         entries = set()
         for mname in cls.methods:
             is_dunder = mname.startswith("__") and mname.endswith("__")
+            # thread_ENTRIES (direct spawn targets / do_* handlers) seed a
+            # zero-held context; thread-side helpers reached only through
+            # locked call sites inherit their callers' held sets instead
+            # of being falsely seeded lock-free
             if (not mname.startswith("_") or is_dunder
-                    or mname in cls.thread_side
+                    or mname in cls.thread_entries
                     or mname not in callers):
                 entries.add(mname)
         cls.contexts = {m: {} for m in cls.methods}

@@ -66,16 +66,41 @@ import itertools
 import json
 import os
 import random
+import re
 import threading
 import time
 from collections import deque
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Tuple
 
 _ID_COUNTER = itertools.count(1)  # __next__ is GIL-atomic: no lock needed
 
 
 def _new_id(prefix: str) -> str:
     return f"{prefix}{next(_ID_COUNTER):x}"
+
+
+# W3C Trace Context traceparent (https://www.w3.org/TR/trace-context/):
+# a version-00 parser reads the first four fields and, for versions ABOVE
+# 00, tolerates appended future fields; version 00 itself must have
+# exactly four, version 0xff and all-zero trace/span ids are invalid
+_TRACEPARENT = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})"
+    r"(-[^\s]*)?$")
+
+
+def _w3c_hex(ident: Optional[str], width: int) -> str:
+    """Render an internal id ("t2a"/"s1f") or an adopted 32-hex trace id
+    as a W3C fixed-width lowercase hex field (all-zero is invalid per
+    spec, so 0 maps to 1)."""
+    h = ident or ""
+    if h and h[0] in "ts":
+        h = h[1:]
+    try:
+        v = int(h, 16)
+    except ValueError:
+        v = int.from_bytes(h.encode(), "big")
+    v %= 16 ** width
+    return format(v or 1, f"0{width}x")
 
 
 class _NullSpan:
@@ -180,14 +205,27 @@ class Tracer:
 
     def __init__(self, capacity: int = 256, sample_rate: float = 1.0,
                  slow_ms: Optional[float] = None, seed: Optional[int] = None,
-                 enabled: bool = True, jax_annotations: bool = False) -> None:
+                 enabled: bool = True, jax_annotations: bool = False,
+                 slow_reserve: float = 0.25) -> None:
         self.capacity = int(capacity)
         self.sample_rate = float(sample_rate)
         self.slow_ms = slow_ms
         self.enabled = bool(enabled)
         self.jax_annotations = bool(jax_annotations)
         self._rng = random.Random(seed)
-        self._ring: deque = deque(maxlen=self.capacity)  # committed traces
+        # slow-trace retention: with slow_ms set, a fraction of the ring is
+        # RESERVED for slow_ms-qualified traces — under sustained overload
+        # a flood of fast sampled traces would otherwise FIFO-evict the
+        # slow outliers that are the whole point of the slow escape. The
+        # two rings share one commit sequence so traces() stays ordered.
+        reserved = int(self.capacity * float(slow_reserve)) \
+            if slow_ms is not None else 0
+        reserved = min(reserved, max(0, self.capacity - 1))
+        self.slow_reserved = reserved
+        self._ring: deque = deque(maxlen=self.capacity - reserved)
+        self._slow_ring: Optional[deque] = \
+            deque(maxlen=reserved) if reserved else None
+        self._seq = 0  # commit order across both rings (guarded by _lock)
         self._lock = threading.Lock()
         self.dropped = 0  # unsampled-and-fast roots (observability of loss)
 
@@ -221,11 +259,48 @@ class Tracer:
         with self._lock:
             return self._rng.random() < self.sample_rate
 
+    # -- W3C Trace Context (traceparent) -------------------------------------
+
+    @staticmethod
+    def parse_traceparent(header: Optional[str]
+                          ) -> Optional[Tuple[str, str, bool]]:
+        """Parse a W3C ``traceparent`` header into a remote context
+        ``(trace_id, parent_span_id, sampled_flag)`` usable as
+        ``begin/span(remote=...)``. Returns None on anything malformed —
+        version 0xff, wrong field widths, all-zero ids — so the caller
+        falls back to a fresh trace (the fail-open contract)."""
+        if not header or not isinstance(header, str):
+            return None
+        m = _TRACEPARENT.match(header.strip().lower())
+        if m is None:
+            return None
+        version, trace_id, span_id, flags, extra = m.groups()
+        if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+            return None
+        if extra is not None and version == "00":
+            return None  # version 00 has exactly four fields
+        return trace_id, span_id, bool(int(flags, 16) & 1)
+
+    def format_traceparent(self, span) -> Optional[str]:
+        """The ``traceparent`` to echo back for ``span``: its trace id
+        (the adopted client id verbatim for remote-parented roots) and
+        ITS span id as the new parent, sampled flag from the trace's
+        commit decision. None when the span records nothing."""
+        if span is None or not getattr(span, "recording", False):
+            return None
+        flags = "01" if span.sampled else "00"
+        return (f"00-{_w3c_hex(span.trace_id, 32)}-"
+                f"{_w3c_hex(span.span_id, 16)}-{flags}")
+
     def begin(self, name: str, parent=_UNSET,
-              start_ns: Optional[int] = None, args: Optional[dict] = None):
+              start_ns: Optional[int] = None, args: Optional[dict] = None,
+              remote: Optional[Tuple[str, str, bool]] = None):
         """Open a span (manual pairing with end(); prefer span()). parent
         defaults to the calling thread's current span; pass an explicit
-        Span for cross-thread parenting or None to force a new root."""
+        Span for cross-thread parenting or None to force a new root.
+        ``remote`` (a parse_traceparent result) makes the new root adopt
+        the client's trace id and parent the client's span — it applies
+        only when no local parent is in effect."""
         if not self.enabled:
             return NULL_SPAN
         if parent is _UNSET:
@@ -233,13 +308,23 @@ class Tracer:
         if parent is not None and parent.recording:
             trace = parent._trace
             parent_id = parent.span_id
+            span = Span(name, trace, parent_id,
+                        start_ns if start_ns is not None
+                        else time.perf_counter_ns())
         else:
-            trace = _Trace(_new_id("t"), self._sample())
-            parent_id = None
-        span = Span(name, trace,
-                    parent_id, start_ns if start_ns is not None
-                    else time.perf_counter_ns())
-        if parent_id is None:
+            if remote is not None:
+                # adopt the client's trace: their trace id IS ours, their
+                # span is our root's parent; their sampled flag is a vote,
+                # not a veto — our sampler can still commit the trace
+                r_trace, r_span, r_sampled = remote
+                trace = _Trace(r_trace, r_sampled or self._sample())
+                parent_id = r_span
+            else:
+                trace = _Trace(_new_id("t"), self._sample())
+                parent_id = None
+            span = Span(name, trace, parent_id,
+                        start_ns if start_ns is not None
+                        else time.perf_counter_ns())
             trace.root = span
         if args:
             span.args.update(args)
@@ -256,8 +341,8 @@ class Tracer:
         if span is not trace.root:
             return
         dur_ms = (span.end_ns - span.start_ns) / 1e6
-        if trace.sampled or (self.slow_ms is not None
-                             and dur_ms >= self.slow_ms):
+        slow = self.slow_ms is not None and dur_ms >= self.slow_ms
+        if trace.sampled or slow:
             committed = {
                 "trace_id": trace.trace_id,
                 "root": span.name,
@@ -266,20 +351,36 @@ class Tracer:
                 "spans": [s.to_dict() for s in trace.spans],
             }
             with self._lock:
-                self._ring.append(committed)
+                committed["seq"] = self._seq
+                self._seq += 1
+                # slow outliers land in their reserved slots, where a
+                # flood of fast sampled traces cannot FIFO-evict them; the
+                # reserve is a FLOOR, not a partition — when it is full
+                # the oldest slow trace overflows into the general ring
+                # and competes there, so an all-slow workload still
+                # retains up to the full capacity
+                if slow and self._slow_ring is not None:
+                    if len(self._slow_ring) == self._slow_ring.maxlen:
+                        self._ring.append(self._slow_ring.popleft())
+                    self._slow_ring.append(committed)
+                else:
+                    self._ring.append(committed)
         else:
             with self._lock:  # read-modify-write: racy without the lock
                 self.dropped += 1
 
     @contextlib.contextmanager
     def span(self, name: str, parent=_UNSET,
-             args: Optional[dict] = None) -> Iterator[Span]:
+             args: Optional[dict] = None,
+             remote: Optional[Tuple[str, str, bool]] = None
+             ) -> Iterator[Span]:
         """Context-managed span, set as the thread's current for its
-        extent so nested spans parent automatically. With
+        extent so nested spans parent automatically. ``remote`` threads a
+        parsed client ``traceparent`` through to begin(). With
         ``jax_annotations=True`` the extent is also wrapped in a
         jax.profiler.TraceAnnotation, so the stage shows up in xprof
         device timelines under the same name."""
-        span = self.begin(name, parent=parent, args=args)
+        span = self.begin(name, parent=parent, args=args, remote=remote)
         if span is NULL_SPAN:
             yield span
             return
@@ -320,9 +421,14 @@ class Tracer:
 
     def traces(self, n: Optional[int] = None) -> List[dict]:
         """The last ``n`` committed traces, oldest first (n=None: all;
-        n <= 0: none — NOT all: out[-0:] would be the whole list)."""
+        n <= 0: none — NOT all: out[-0:] would be the whole list). The
+        general and reserved-slow rings merge back into one commit-order
+        stream."""
         with self._lock:
             out = list(self._ring)
+            if self._slow_ring is not None and self._slow_ring:
+                out = sorted(out + list(self._slow_ring),
+                             key=lambda t: t["seq"])
         if n is not None:
             n = int(n)
             out = out[-n:] if n > 0 else []
@@ -331,6 +437,9 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
+            if self._slow_ring is not None:
+                self._slow_ring.clear()
+            self._seq = 0
             self.dropped = 0
 
     def slowest(self, k: int = 5, n: Optional[int] = None) -> List[dict]:
@@ -424,6 +533,8 @@ def _env_float(name: str, default: float) -> float:
 #   HIVEMALL_TPU_TRACE=0             disable entirely
 #   HIVEMALL_TPU_TRACE_SAMPLE=0.1    sample 10% of roots
 #   HIVEMALL_TPU_TRACE_SLOW_MS=50    always commit roots >= 50 ms
+#   HIVEMALL_TPU_TRACE_SLOW_RESERVE=0.25  ring fraction reserved for slow
+#                                    traces (only meaningful with SLOW_MS)
 #   HIVEMALL_TPU_TRACE_CAPACITY=256  ring size (committed traces)
 #   HIVEMALL_TPU_TRACE_JAX=1         bridge spans into jax TraceAnnotations
 _slow = os.environ.get("HIVEMALL_TPU_TRACE_SLOW_MS")
@@ -433,6 +544,7 @@ TRACER = Tracer(
     slow_ms=float(_slow) if _slow else None,
     enabled=os.environ.get("HIVEMALL_TPU_TRACE", "1") != "0",
     jax_annotations=os.environ.get("HIVEMALL_TPU_TRACE_JAX", "0") == "1",
+    slow_reserve=_env_float("HIVEMALL_TPU_TRACE_SLOW_RESERVE", 0.25),
 )
 
 

@@ -1,4 +1,5 @@
-"""Online inference: frozen artifacts, bucketed engines, micro-batching,
+"""Online inference: frozen artifacts, bucketed engines, overload-grade
+micro-batching (priorities / quotas / deadlines / adaptive windows),
 hot-swap registry + /predict endpoint — docs/serving.md.
 
     from hivemall_tpu.serving import freeze, ModelRegistry, serve
@@ -9,8 +10,10 @@ hot-swap registry + /predict endpoint — docs/serving.md.
     server = serve(registry, port=8080)
 """
 
+from .admission import (AIMDController, DeadlineExpired, PRIORITY_NAMES,
+                        QueueFull, ShedLowPriority, priority_class)
 from .artifact import Artifact, family_of, freeze, load
-from .batcher import BatcherClosed, DynamicBatcher, QueueFull
+from .batcher import BatcherClosed, DynamicBatcher
 from .engine import Servable, ServingEngine, make_servable
 from .placement import (ModelExceedsDeviceBudget, ModelSharded, Placement,
                         Replicated, SingleDevice)
@@ -19,6 +22,8 @@ from .server import ModelEntry, ModelRegistry, serve
 __all__ = [
     "Artifact", "family_of", "freeze", "load",
     "DynamicBatcher", "QueueFull", "BatcherClosed",
+    "AIMDController", "DeadlineExpired", "ShedLowPriority",
+    "PRIORITY_NAMES", "priority_class",
     "Servable", "ServingEngine", "make_servable",
     "Placement", "SingleDevice", "Replicated", "ModelSharded",
     "ModelExceedsDeviceBudget",

@@ -34,6 +34,7 @@ for that.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
@@ -993,6 +994,13 @@ class ServingEngine:
         self._rows = REGISTRY.counter("serving", f"{name}.rows")
         self._truncated = REGISTRY.counter("serving", f"{name}.truncated_rows")
         self.warmed_buckets: List[Tuple[int, Optional[int]]] = []
+        # dispatch-level service-rate estimate (rows/sec EWMA over recent
+        # predicts) — the capacity signal the overload surface reads:
+        # /metrics exports it and the batcher's Retry-After math uses its
+        # own copy of the same quantity. The express and general batcher
+        # lanes both call predict, so the read-modify-write is guarded.
+        self.rows_per_sec = 0.0
+        self._rate_lock = threading.Lock()
         # per-model precision surface (/models + /metrics): the dtype the
         # tables serve at and the resident bytes a request's gathers read —
         # what bf16/int8 artifacts shrink 2-4x
@@ -1119,8 +1127,16 @@ class ServingEngine:
                         out = self.servable.finalize(raw, chunk_n)
                 outs.append(out)
             self._rows.increment(n)
-            self._latency.observe(time.perf_counter() - t0,
-                                  trace_id=TRACER.exemplar_id(pspan))
+            dt = time.perf_counter() - t0
+            self._latency.observe(dt, trace_id=TRACER.exemplar_id(pspan))
+            if dt > 0:
+                inst = n / dt
+                with self._rate_lock:
+                    self.rows_per_sec = inst if self.rows_per_sec <= 0.0 \
+                        else 0.8 * self.rows_per_sec + 0.2 * inst
+                    rate = self.rows_per_sec
+                REGISTRY.set_gauge(f"serving.{self.name}.engine_rows_per_sec",
+                                   rate)
         if len(outs) == 1:
             return outs[0]
         if isinstance(outs[0], np.ndarray):

@@ -12,18 +12,32 @@ the swap still completes: an in-flight v1 -> v2 swap fails zero requests
 HTTP surface (layered on runtime/metrics_http.py — same process, one port):
 
 - ``POST /predict``  body ``{"model": name?, "instances": [...]}`` ->
-  ``{"model", "version", "predictions": [...]}``; 503 + Retry-After under
-  backpressure (batcher QueueFull), 404 unknown model, 400 bad payload;
-- ``GET /models``    registry listing (name, version, family, counters);
-- ``GET /metrics`` / ``GET /healthz`` / ``GET /trace?n=`` — inherited from
-  metrics_http: the serving latency/occupancy/queue histograms (with
-  trace exemplars under ``?exemplars=1``) and the last n request traces
-  as Chrome/Perfetto JSON (docs/observability.md).
+  ``{"model", "version", "predictions": [...]}``. Overload contract
+  (docs/serving.md "Overload behavior"): requests may carry an
+  ``x-priority`` header (high/normal/low, or body key ``priority``) and
+  an ``x-deadline-ms`` budget (or body key ``deadline_ms``); a request
+  that expires in the queue gets **504** (``reason: deadline``), an
+  over-quota or shed request gets **503 + Retry-After** priced from the
+  live drain-rate estimate (``reason: quota`` / ``shed``); 404 unknown
+  model, 400 bad payload. A client ``traceparent`` header (W3C) is
+  adopted as the request trace's root parent and echoed back on every
+  response; malformed headers fall back to a fresh trace;
+- ``GET /models``    registry listing (name, version, family, admission
+  and placement state, counters);
+- ``GET /healthz``   overload-aware: reports ``degraded`` (still 200 —
+  alive, shedding predictably) when any model's queue passes the depth
+  threshold, BEFORE the process ever looks dead;
+- ``GET /metrics`` / ``GET /trace?n=`` — inherited from metrics_http:
+  the serving latency/occupancy/queue histograms, per-priority
+  shed/expiry/quota counters and live controller state (with trace
+  exemplars under ``?exemplars=1``), and the last n request traces as
+  Chrome/Perfetto JSON (docs/observability.md).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from http.server import ThreadingHTTPServer
@@ -34,6 +48,7 @@ import numpy as np
 from ..runtime import metrics_http
 from ..runtime.metrics import REGISTRY
 from ..runtime.tracing import TRACER
+from .admission import DeadlineExpired, priority_class, priority_name
 from .batcher import BatcherClosed, DynamicBatcher, QueueFull
 from .engine import ServingEngine
 
@@ -68,6 +83,10 @@ class ModelEntry:
             # mesh shape, stripe grids and per-device resident bytes
             # (docs/serving.md "Sharded serving")
             "placement": self.engine.placement,
+            # the overload surface: queue depth per priority class,
+            # quota fractions, live AIMD controller window, drain-rate
+            # estimate and shed/expiry/quota-reject counters
+            "admission": self.batcher.overload_state(),
         }
 
 
@@ -79,9 +98,23 @@ class ModelRegistry:
     never invalidates an in-flight request — the old batcher drains.
     """
 
+    # serving-grade admission defaults: every model's batcher gets the
+    # full overload posture unless a deploy overrides it — low-priority
+    # work quota-sheds at 60% queue fill, normal at 85%, high keeps
+    # headroom to the cap (docs/serving.md "Overload behavior"); adaptive
+    # caps stay equal to the bases (off) unless configured, so light-load
+    # latency semantics are identical to the fixed-window batcher.
+    DEFAULT_QUOTA_FRACS = (1.0, 0.85, 0.6)
+
     def __init__(self, *, max_batch: int = 256, max_delay_ms: float = 2.0,
                  max_queue_rows: int = 4096, warmup: bool = True,
-                 engine_kwargs: Optional[dict] = None) -> None:
+                 engine_kwargs: Optional[dict] = None,
+                 max_delay_ms_cap: Optional[float] = None,
+                 max_batch_cap: Optional[int] = None,
+                 priority_quota_fracs: Optional[tuple] = None,
+                 starvation_limit: int = 8,
+                 express_high: bool = True,
+                 degraded_depth_fraction: float = 0.75) -> None:
         self._entries: Dict[str, ModelEntry] = {}
         self._lock = threading.Lock()
         self.max_batch = max_batch
@@ -89,16 +122,34 @@ class ModelRegistry:
         self.max_queue_rows = max_queue_rows
         self.warmup = warmup
         self.engine_kwargs = dict(engine_kwargs or {})
+        self.max_delay_ms_cap = max_delay_ms_cap
+        self.max_batch_cap = max_batch_cap
+        self.priority_quota_fracs = tuple(
+            priority_quota_fracs or self.DEFAULT_QUOTA_FRACS)
+        self.starvation_limit = starvation_limit
+        # high-priority requests get a dedicated drain lane by default —
+        # they never wait behind an in-flight lower-class dispatch
+        # (serving/batcher.py "express lane")
+        self.express_high = express_high
+        # /healthz flips to "degraded" when any model's queue fills past
+        # this fraction — overload is reported while the process is still
+        # very much alive and shedding predictably
+        self.degraded_depth_fraction = float(degraded_depth_fraction)
         self._swaps = REGISTRY.counter("serving", "registry.swaps")
 
     def deploy(self, name: str, source, version: Optional[str] = None,
+               batcher_overrides: Optional[dict] = None,
                **engine_overrides) -> ModelEntry:
         """Deploy `source` (artifact dir path, Artifact, or trained model)
         as `name`; replaces any current version atomically AFTER the new
         engine is fully warmed (no cold-cache window under load). The
         version defaults to the artifact's manifest version (so /predict
         responses correlate with the frozen directory, rollbacks included);
-        bare model objects auto-increment."""
+        bare model objects auto-increment. ``batcher_overrides`` tunes
+        this model's admission posture (max_queue_rows, quota fractions,
+        adaptive caps, starvation limit) over the registry defaults —
+        per-model quotas are per-model BATCHERS: each model owns its
+        queue, so one model's flood can never 503 another."""
         from .artifact import Artifact, load as load_artifact
 
         if isinstance(source, str):
@@ -116,10 +167,16 @@ class ModelRegistry:
                 and old.version.isdigit() else "1"
         if self.warmup:
             engine.warmup()
-        batcher = DynamicBatcher(
-            engine.predict, max_batch=engine.max_batch,
-            max_delay_ms=self.max_delay_ms,
-            max_queue_rows=self.max_queue_rows, name=name)
+        bkw = dict(max_batch=engine.max_batch,
+                   max_delay_ms=self.max_delay_ms,
+                   max_queue_rows=self.max_queue_rows,
+                   max_delay_ms_cap=self.max_delay_ms_cap,
+                   max_batch_cap=self.max_batch_cap,
+                   priority_quota_fracs=self.priority_quota_fracs,
+                   starvation_limit=self.starvation_limit,
+                   express_high=self.express_high)
+        bkw.update(batcher_overrides or {})
+        batcher = DynamicBatcher(engine.predict, name=name, **bkw)
         entry = ModelEntry(name, str(version), engine, batcher)
         with self._lock:
             old = self._entries.get(name)
@@ -151,7 +208,8 @@ class ModelRegistry:
     # is not a reachable steady state
     _SWAP_RETRIES = 8
 
-    def submit(self, name: Optional[str], instances):
+    def submit(self, name: Optional[str], instances, *,
+               priority="normal", deadline_ms: Optional[float] = None):
         """Resolve + enqueue, retrying across hot swaps: a caller that
         resolved the OLD entry right before deploy() published the new one
         sees BatcherClosed from the draining batcher — re-resolving gets
@@ -159,18 +217,55 @@ class ModelRegistry:
         (entry, future); (None, None) means the name is genuinely unknown
         (never deployed, or undeployed). QueueFull propagates (backpressure
         is the caller's 503); BatcherClosed escapes only after
-        _SWAP_RETRIES consecutive swap collisions (retryable, also 503)."""
+        _SWAP_RETRIES consecutive swap collisions (retryable, also 503).
+        ``priority``/``deadline_ms`` thread through to the batcher's
+        admission decision (serving/batcher.py)."""
         for _ in range(self._SWAP_RETRIES):
             entry = self.get(name)
             if entry is None:
                 return None, None
             try:
-                return entry, entry.batcher.submit(instances)
+                return entry, entry.batcher.submit(
+                    instances, priority=priority, deadline_ms=deadline_ms)
             except BatcherClosed:
                 continue
         raise BatcherClosed(
             f"model {name!r}: {self._SWAP_RETRIES} consecutive version "
             f"swaps collided with this submit — retry")
+
+    def health(self) -> dict:
+        """Overload-aware health: ``degraded`` (still alive — shedding
+        predictably) when any model's queue fills past
+        ``degraded_depth_fraction``; the status a load balancer should
+        read BEFORE the process ever looks dead."""
+        with self._lock:
+            entries = list(self._entries.values())
+        models, worst = {}, 0.0
+        for e in entries:
+            st = e.batcher.overload_state()
+            worst = max(worst, st["depth_fraction"])
+            models[e.name] = {
+                "depth_fraction": st["depth_fraction"],
+                "depth_rows": st["depth_rows"],
+                "controller": st["controller"],
+                "shed": st["shed"], "expired": st["expired"],
+                "quota_rejected": st["quota_rejected"],
+            }
+        info = {
+            "status": "degraded" if worst >= self.degraded_depth_fraction
+            else "ok",
+            "degraded_depth_fraction": self.degraded_depth_fraction,
+            "worst_depth_fraction": round(worst, 4),
+            "models": models,
+        }
+        try:
+            import jax
+
+            info["process_index"] = jax.process_index()
+            info["local_devices"] = len(jax.local_devices())
+        except Exception:  # jax not initialized yet — still alive
+            pass
+        return info
 
     def undeploy(self, name: str) -> bool:
         with self._lock:
@@ -194,8 +289,14 @@ class ModelRegistry:
 
 
 class _ServingHandler(metrics_http._Handler):
-    """Extends the metrics handler with /predict and /models. The registry
-    rides on the server object (see serve())."""
+    """Extends the metrics handler with /predict, /models and the
+    overload-aware /healthz. The registry rides on the server object
+    (see serve())."""
+
+    # persistent connections: the overload bench (and any real client)
+    # reuses sockets instead of burning an ephemeral port per request;
+    # every response carries Content-Length, so keep-alive is safe
+    protocol_version = "HTTP/1.1"
 
     predict_timeout = 30.0
 
@@ -210,53 +311,156 @@ class _ServingHandler(metrics_http._Handler):
         self.wfile.write(body)
 
     def do_GET(self):  # noqa: N802 - http.server API
-        if self.path.split("?")[0] == "/models":
+        path = self.path.split("?")[0]
+        if path == "/models":
             self._send_json(200, {"models": self.server.registry.list_models()})
+            return
+        if path == "/healthz":
+            # overload-aware liveness: "degraded" reports a server that is
+            # alive and shedding predictably BEFORE it ever looks dead
+            self._send_json(200, self.server.registry.health())
             return
         super().do_GET()
 
+    def _drain_body(self) -> None:
+        """Read and discard the request body so the keep-alive connection
+        stays in sync on paths that never parse it (the door 503, the
+        POST 404)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:  # garbage header: nothing trustworthy to drain
+            length = 0
+        self.rfile.read(length)
+
     def do_POST(self):  # noqa: N802 - http.server API
         if self.path.split("?")[0] != "/predict":
+            self._drain_body()
             self._send_json(404, {"error": "not found"})
             return
+        # concurrency admission, at the door: past the in-flight limit the
+        # request is refused BEFORE its body is parsed — under overload
+        # the handler threads' own parse work would otherwise starve the
+        # batcher worker of the very CPU that IS the service capacity.
+        # The body is still drained so the keep-alive connection stays
+        # usable; 503s are deliberately cheap.
+        sem = getattr(self.server, "inflight", None)
+        held = None
+        if sem is not None:
+            if sem.acquire(blocking=False):
+                held = sem
+            else:
+                # the door must not undo the priority classes: requests
+                # whose x-priority HEADER says "high" may still enter
+                # through the reserved slots (body-priority requests
+                # cannot — the point of the door is deciding before the
+                # body is parsed)
+                hdr = (self.headers.get("x-priority") or "").strip().lower()
+                reserve = getattr(self.server, "inflight_reserve", None)
+                if hdr in ("high", "0") and reserve is not None \
+                        and reserve.acquire(blocking=False):
+                    held = reserve
+            if held is None:
+                self._drain_body()
+                self.server.concurrency_rejected.increment()
+                self._send_json(503,
+                                {"error": "too many in-flight requests",
+                                 "reason": "concurrency"},
+                                extra_headers=(("Retry-After", "1"),))
+                return
+        try:
+            self._predict()
+        finally:
+            if held is not None:
+                held.release()
+
+    def _predict(self) -> None:
         # the request's ROOT span: HTTP parse, queue wait, batched device
         # dispatch and the response write all land under it; the latency
-        # histogram observation carries its trace_id as an exemplar
-        with TRACER.span("server.predict") as root:
+        # histogram observation carries its trace_id as an exemplar. A
+        # client W3C traceparent is adopted as the root's parent (PR 5
+        # leftover) and echoed back with OUR root span as the new parent;
+        # a malformed header parses to None — a fresh trace.
+        remote = TRACER.parse_traceparent(self.headers.get("traceparent"))
+        with TRACER.span("server.predict", remote=remote) as root:
+            tp = TRACER.format_traceparent(root)
+            tp_hdr = (("traceparent", tp),) if tp else ()
             with TRACER.span("server.parse"):
+                close_hdr = ()
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                    except ValueError:
+                        # body length unknowable: the socket cannot be
+                        # drained back into sync — close it with the 400
+                        close_hdr = (("Connection", "close"),)
+                        raise
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     instances = payload["instances"]
                     if not isinstance(instances, list):
                         raise TypeError("instances must be a list")
+                    # priority class + deadline budget: body keys win over
+                    # the x-priority / x-deadline-ms headers
+                    cls = priority_class(
+                        payload.get("priority",
+                                    self.headers.get("x-priority")
+                                    or "normal"))
+                    deadline_ms = payload.get(
+                        "deadline_ms", self.headers.get("x-deadline-ms"))
+                    if deadline_ms is not None:
+                        deadline_ms = float(deadline_ms)
+                        if not math.isfinite(deadline_ms) \
+                                or deadline_ms <= 0:
+                            raise ValueError(
+                                f"deadline_ms must be a positive number, "
+                                f"got {deadline_ms}")
                 except (KeyError, TypeError, ValueError) as e:
-                    self._send_json(400, {"error": f"bad request: {e}"})
+                    self._send_json(400, {"error": f"bad request: {e}"},
+                                    extra_headers=tp_hdr + close_hdr)
                     root.set(status=400)
                     return
             root.set(instances=len(instances),
-                     model=payload.get("model") or "")
+                     model=payload.get("model") or "",
+                     priority=priority_name(cls),
+                     **({"deadline_ms": deadline_ms}
+                        if deadline_ms is not None else {}))
             t0 = time.perf_counter()
             try:
                 # registry.submit retries across a hot swap, so a v1->v2
                 # deploy never fails a request; only an unknown name /
                 # undeploy 404s
                 entry, future = self.server.registry.submit(
-                    payload.get("model"), instances)
+                    payload.get("model"), instances,
+                    priority=cls, deadline_ms=deadline_ms)
                 if entry is None:
                     self._send_json(404,
                                     {"error": f"unknown model "
-                                              f"{payload.get('model')!r}"})
+                                              f"{payload.get('model')!r}"},
+                                    extra_headers=tp_hdr)
                     root.set(status=404)
                     return
                 preds = future.result(timeout=self.predict_timeout)
+            except DeadlineExpired as e:
+                # expired IN the queue: no dispatch slot was spent on it
+                self._send_json(504, {"error": str(e),
+                                      "reason": "deadline"},
+                                extra_headers=tp_hdr)
+                root.set(status=504)
+                return
             except (QueueFull, BatcherClosed) as e:
-                self._send_json(503, {"error": str(e)},
-                                extra_headers=(("Retry-After", "1"),))
+                # quota refusal, low-priority shed, or a swap-collision
+                # storm — all retryable; Retry-After is priced from the
+                # live drain-rate estimate so clients back off usefully
+                ra = getattr(e, "retry_after_s", None) or 1.0
+                self._send_json(
+                    503, {"error": str(e),
+                          "reason": getattr(e, "reason", "busy")},
+                    extra_headers=tp_hdr + (
+                        ("Retry-After", str(int(math.ceil(ra)))),))
                 root.set(status=503)
                 return
             except Exception as e:  # scoring bug — surface, don't hang
-                self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"},
+                                extra_headers=tp_hdr)
                 root.set(status=500)
                 return
             self.server.latency.observe(
@@ -267,7 +471,7 @@ class _ServingHandler(metrics_http._Handler):
                 "model": entry.name,
                 "version": entry.version,
                 "predictions": [_jsonable(p) for p in preds],
-            })
+            }, extra_headers=tp_hdr)
 
 
 def _jsonable(p):
@@ -278,14 +482,32 @@ def _jsonable(p):
     return p
 
 
-def serve(registry: ModelRegistry, port: int = 0, host: str = "127.0.0.1"
+def serve(registry: ModelRegistry, port: int = 0, host: str = "127.0.0.1",
+          max_concurrent_requests: Optional[int] = None
           ) -> ThreadingHTTPServer:
     """Start the serving endpoint on a daemon thread (stdlib only, the
     serve_metrics recipe); ``server.server_address[1]`` is the bound port.
-    The same server answers /predict, /models, /metrics and /healthz."""
+    The same server answers /predict, /models, /metrics and /healthz.
+
+    ``max_concurrent_requests`` bounds in-flight /predict handlers: past
+    the limit requests get an immediate cheap 503 (``reason:
+    concurrency``) before their body is parsed — the third admission
+    dimension next to queue-row quotas and deadlines (docs/serving.md
+    "Overload behavior"). A quarter of the limit again is reserved for
+    requests whose ``x-priority`` header says high, so the door cannot
+    undo the priority classes. None (default) leaves it unbounded."""
     server = ThreadingHTTPServer((host, port), _ServingHandler)
     server.registry = registry
     server.latency = REGISTRY.histogram("serving.http.latency_seconds")
+    if max_concurrent_requests is None:
+        server.inflight = server.inflight_reserve = None
+    else:
+        n = int(max_concurrent_requests)
+        server.inflight = threading.BoundedSemaphore(n)
+        server.inflight_reserve = threading.BoundedSemaphore(
+            max(2, n // 4))
+    server.concurrency_rejected = REGISTRY.counter(
+        "serving", "http.concurrency_rejected")
     t = threading.Thread(target=server.serve_forever, daemon=True,
                          name="hivemall-tpu-serving")
     t.start()

@@ -527,3 +527,118 @@ def test_tracer_overhead_under_5_percent():
     assert delta < 0.05, (f"tracing overhead {delta:.1%} in the best "
                           f"pairing (all pairs: "
                           f"{[f'{d:.1%}' for d in deltas]})")
+
+
+# -- W3C traceparent (client-supplied trace context) -------------------------
+
+def test_parse_traceparent_valid_and_malformed():
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    sid = "00f067aa0ba902b7"
+    assert Tracer.parse_traceparent(f"00-{tid}-{sid}-01") == (tid, sid, True)
+    assert Tracer.parse_traceparent(f"00-{tid}-{sid}-00") == (tid, sid, False)
+    # uppercase hex normalizes; surrounding whitespace is tolerated
+    assert Tracer.parse_traceparent(f"  00-{tid.upper()}-{sid}-01 ") \
+        == (tid, sid, True)
+    # a version-00 parser accepts FUTURE versions with appended fields...
+    assert Tracer.parse_traceparent(f"01-{tid}-{sid}-01-extra.data") \
+        == (tid, sid, True)
+    for bad in (None, "", "nonsense", f"00-{tid}-{sid}",  # missing field
+                f"ff-{tid}-{sid}-01",                     # version 0xff
+                f"00-{'0' * 32}-{sid}-01",                # all-zero trace
+                f"00-{tid}-{'0' * 16}-01",                # all-zero span
+                f"00-{tid[:-1]}-{sid}-01",                # short trace id
+                f"00-{tid}-{sid}-01-extra",               # ...but 00 is
+                f"00-{tid}-{sid}-zz"):                    # exactly four
+        assert Tracer.parse_traceparent(bad) is None
+
+
+def test_remote_parent_adopts_trace_and_echo_format():
+    t = Tracer(sample_rate=0.0, seed=0)  # sampled only via the flag
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    remote = Tracer.parse_traceparent(f"00-{tid}-00f067aa0ba902b7-01")
+    with t.span("server.predict", remote=remote) as root:
+        assert root.trace_id == tid           # client's trace id adopted
+        assert root.parent_id == "00f067aa0ba902b7"
+        assert root.sampled is True           # the flag is a vote
+        echo = t.format_traceparent(root)
+    ver, e_tid, e_sid, flags = echo.split("-")
+    assert (ver, e_tid, flags) == ("00", tid, "01")
+    assert len(e_sid) == 16 and int(e_sid, 16) > 0  # OUR span, W3C shaped
+    assert [tr["trace_id"] for tr in t.traces()] == [tid]
+    # remote applies only to roots: a nested span keeps the local parent
+    with t.span("outer", remote=remote) as outer:
+        with t.span("inner", remote=remote) as inner:
+            assert inner.parent_id == outer.span_id
+    # unsampled-flag remote with sampling off: timed but not committed
+    t2 = Tracer(sample_rate=0.0, seed=0)
+    with t2.span("r", remote=Tracer.parse_traceparent(
+            f"00-{tid}-00f067aa0ba902b7-00")):
+        pass
+    assert t2.traces() == [] and t2.dropped == 1
+
+
+def test_format_traceparent_internal_ids_and_nullspan():
+    t = Tracer(sample_rate=1.0, seed=0)
+    with t.span("r") as root:
+        echo = t.format_traceparent(root)
+    ver, e_tid, e_sid, flags = echo.split("-")
+    assert (ver, flags) == ("00", "01")
+    assert len(e_tid) == 32 and int(e_tid, 16) > 0
+    assert len(e_sid) == 16
+    assert t.format_traceparent(None) is None
+    off = Tracer(enabled=False)
+    with off.span("r") as nullspan:
+        assert off.format_traceparent(nullspan) is None
+
+
+# -- slow-trace retention (reserved ring fraction) ---------------------------
+
+def test_slow_traces_survive_fast_flood():
+    """PR 5 leftover: with slow_ms set, a fraction of the ring is reserved
+    for slow-qualified traces — a flood of fast sampled traces must not
+    FIFO-evict the slow outliers (exactly the traces overload debugging
+    needs)."""
+    t = Tracer(capacity=8, sample_rate=1.0, slow_ms=5.0, seed=0,
+               slow_reserve=0.25)
+    assert t.slow_reserved == 2
+    with t.span("slow_one"):
+        time.sleep(0.012)
+    for i in range(30):
+        with t.span(f"fast{i}"):
+            pass
+    roots = [tr["root"] for tr in t.traces()]
+    assert "slow_one" in roots, "fast flood evicted the slow outlier"
+    assert len(roots) <= 8  # total capacity unchanged: reserve is carved out
+    # commit order is preserved across the merged rings
+    assert roots[0] == "slow_one"
+    assert roots[1:] == [f"fast{i}" for i in range(24, 30)]
+    # slowest() sees the retained outlier
+    assert t.slowest(1)[0]["root"] == "slow_one"
+    t.clear()
+    assert t.traces() == []
+
+
+def test_slow_reserve_is_a_floor_not_a_partition():
+    t = Tracer(capacity=8, sample_rate=1.0, slow_ms=5.0, seed=0,
+               slow_reserve=0.25)
+    # more slow traces than reserved slots: the overflow competes in the
+    # general ring, so an all-slow workload retains up to full capacity
+    for i in range(4):
+        with t.span(f"slow{i}"):
+            time.sleep(0.008)
+    slow_roots = [tr["root"] for tr in t.traces() if tr["root"].startswith("slow")]
+    assert slow_roots == ["slow0", "slow1", "slow2", "slow3"]
+    # a fast flood can evict the overflowed slow traces but never the
+    # newest `reserved` ones
+    for i in range(20):
+        with t.span(f"fast{i}"):
+            pass
+    kept = [tr["root"] for tr in t.traces() if tr["root"].startswith("slow")]
+    assert kept == ["slow2", "slow3"]
+    # no slow_ms -> no reserve: legacy FIFO semantics bit-for-bit
+    plain = Tracer(capacity=3, seed=0)
+    assert plain.slow_reserved == 0
+    for i in range(5):
+        with plain.span(f"r{i}"):
+            pass
+    assert [tr["root"] for tr in plain.traces()] == ["r2", "r3", "r4"]
