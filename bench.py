@@ -33,7 +33,21 @@ ESTIMATED_JVM_MAPPER_ROWS_PER_SEC = 250_000.0  # labeled secondary anchor
 
 WIDTH = 32  # nnz per row, KDD CTR-ish
 DIMS = 1 << 22
+CACHE_PRESSURE_DIMS = 1 << 24  # w+cov f32 = 128 MB, past any cache this
+# fleet runs on — the bandwidth-bound regime where int8 serving and the
+# batched trainer both earn their keep (the PR 7 honest finding, promoted
+# from a smoke note to a standing scoreboard entry)
 FM_FACTORS = 5
+
+# AdaBatch sweep (PAPERS.md): the batch-size/accuracy trade measured, not
+# assumed. Every B reports throughput AND holdout logloss; the chosen
+# default is the fastest B whose logloss sits within the pinned tolerance
+# of B=1. {1..512} is the study grid; the >512 tail exists because on
+# CPU the dedup win keeps growing with the chunk and the accuracy cost is
+# what the tolerance is for.
+BATCH_SWEEP = (1, 8, 32, 128, 512, 2048, 8192)
+BATCH_PARITY_TOL_LOGLOSS = 0.02  # same pin bench_serving uses for int8
+BATCH_SMOKE_MIN_VS_SCAN = 1.5  # tier-1 gate: batched >= 1.5x row-serial
 
 
 def make_ids(rng, shape, dims=DIMS):
@@ -74,6 +88,65 @@ def _measure_anchors() -> dict:
     return out
 
 
+def _std_sigmoid_logloss(scores, labels) -> float:
+    """Holdout logloss of standardized scores. Margin classifiers emit
+    uncalibrated scores, so every arm gets the SAME single-parameter
+    standardization (score / std) before the sigmoid — scale-free and
+    smooth where raw-sigmoid logloss saturates, which is what a batch-size
+    parity comparison needs. Recorded as score_calibration: "std"."""
+    from hivemall_tpu.evaluation.metrics import logloss
+
+    s = np.asarray(scores, np.float32)
+    s = s / max(float(np.std(s)), 1e-9)
+    return logloss(1.0 / (1.0 + np.exp(-s)), labels)
+
+
+def _planted_weights(rng, dims):
+    """The ONE planted weight vector both splits are labeled by — train
+    and holdout must share it or holdout logloss is independent of what
+    the model learned and the parity gate measures score-shape noise."""
+    return (rng.randn(dims) * (rng.rand(dims) < 0.05)).astype(np.float32)
+
+
+def _planted_workload(rng, n, dims, w_true, noise=0.3):
+    """Rows labeled by the SHARED planted weights + label noise, so
+    holdout logloss measures model quality, not chance — the AdaBatch
+    accuracy side needs labels worth predicting."""
+    idx = make_ids(rng, (n, WIDTH), dims)
+    val = np.abs(rng.randn(n, WIDTH)).astype(np.float32)
+    margin = np.einsum("nk,nk->n", val, w_true[idx])
+    lab = np.where(margin + noise * np.std(margin) * rng.randn(n) > 0,
+                   1.0, -1.0).astype(np.float32)
+    return idx, val, lab
+
+
+def _batch_holdout_logloss(b, train, holdout, dims) -> float:
+    """ONE exact epoch of AROW through the batched backend at batch size
+    `b`; returns standardized holdout logloss (see _std_sigmoid_logloss)."""
+    from hivemall_tpu.core.batch_update import (make_batch_train_step,
+                                                stage_block_plans)
+    from hivemall_tpu.core.state import init_linear_state
+    from hivemall_tpu.models.classifier import AROW
+
+    idx, val, lab = train
+    h_idx, h_val, h_lab = holdout
+    step = make_batch_train_step(AROW, {"r": 0.1}, batch_size=b)
+    st = init_linear_state(dims, use_covariance=True)
+    st, _ = step(st, idx, val, lab, stage_block_plans(idx, b, dims))
+    w = np.asarray(st.weights, dtype=np.float32)
+    return _std_sigmoid_logloss(np.einsum("nk,nk->n", h_val, w[h_idx]),
+                                h_lab)
+
+
+def _pick_batch_size(sweep: list) -> int:
+    """The AdaBatch decision: fastest B whose holdout logloss sits within
+    the pinned tolerance of B=1."""
+    ll_b1 = next(e["holdout_logloss"] for e in sweep if e["batch_size"] == 1)
+    ok = [e for e in sweep
+          if abs(e["holdout_logloss"] - ll_b1) <= BATCH_PARITY_TOL_LOGLOSS]
+    return max(ok, key=lambda e: e["rows_per_sec"])["batch_size"]
+
+
 def _measure() -> None:
     """Child body: run AROW + FM scan-epoch measurements on whatever backend
     jax lands on and print one JSON line with the raw numbers.
@@ -83,7 +156,11 @@ def _measure() -> None:
     (io/records.py prefetch + on-device epoch loop; the reference likewise
     replays epochs from its in-memory/NIO buffer,
     FactorizationMachineUDTF.java:521). scripts/bench_arow_methodology.py
-    attributes dispatch overhead separately (analysis in PERF.md)."""
+    attributes dispatch overhead separately (analysis in PERF.md). On CPU
+    the round additionally runs the execution-backend ladder (scan /
+    batch<B> / native_scan, docs/execution_backends.md): the AdaBatch
+    batch-size sweep with holdout logloss, the chosen-B batched headline,
+    and the 2^24-dim cache-pressure regime as standing metrics."""
     import jax
     import jax.numpy as jnp
 
@@ -109,15 +186,16 @@ def _measure() -> None:
     val_d = jnp.asarray(val)
     lab_d = jnp.asarray(lab)
 
-    def timed_epoch_loop(epoch, state):
+    def timed_epoch_loop(epoch, state, staged=None, budget_s=6.0):
         from hivemall_tpu.runtime.benchmark import honest_timed_loop
 
-        state, losses = epoch(state, idx_d, val_d, lab_d)  # compile+warm
+        blocks = staged if staged is not None else (idx_d, val_d, lab_d)
+        state, losses = epoch(state, *blocks)  # compile+warm
         jax.block_until_ready(losses)
-        rows_per_epoch = n_blocks * batch
+        rows_per_epoch = int(blocks[0].shape[0]) * int(blocks[0].shape[1])
 
         def run(s):
-            s2, _ = epoch(s, idx_d, val_d, lab_d)
+            s2, _ = epoch(s, *blocks)
             return s2
 
         # Chunked + budget-bounded + verified: every chunk ends with a
@@ -127,7 +205,7 @@ def _measure() -> None:
         # inflate the rate, and however slow the backend is the loop exits
         # within its budget (no child-timeout risk).
         iters, secs, _ = honest_timed_loop(
-            run, state, lambda s: float(s.step), budget_s=6.0,
+            run, state, lambda s: float(s.step), budget_s=budget_s,
             expect_probe_delta=rows_per_epoch)
         return iters * rows_per_epoch / secs
 
@@ -180,7 +258,95 @@ def _measure() -> None:
         except Exception as e:  # noqa: BLE001
             print(f"bench: fm mxu A/B failed: {e!r}", file=sys.stderr)
     if platform == "cpu":
-        # the framework's host execution backend (-native_scan): exact
+        from hivemall_tpu.core.batch_update import (make_batch_train_fn,
+                                                    stage_epoch_plans)
+
+        # (a) row-serial JAX scan — the 1.5x gate's denominator: the exact
+        # per-row path the batched backend must beat, on a 2-block epoch
+        # (it is the slow arm; the budget bounds it, honest_timed_loop
+        # verifies it)
+        scan_staged = (idx_d[:2], val_d[:2], lab_d[:2])
+        scan_fn = make_train_fn(AROW, {"r": 0.1}, mode="scan")
+        scan_rps = timed_epoch_loop(
+            make_epoch(scan_fn), init_linear_state(DIMS, use_covariance=True),
+            staged=scan_staged, budget_s=4.0)
+        out["arow_scan_rows_per_sec"] = round(scan_rps, 1)
+
+        # (b) the AdaBatch sweep: per B, throughput on a 4-block slice of
+        # the SAME staged workload + holdout logloss on a planted-signal
+        # task (one exact epoch each — batch size is the only variable)
+        # 2^17 train rows: enough that even B=8192 sees 16 updates — the
+        # accuracy side must be measured at a batch count representative
+        # of the 2M-row epochs the throughput side replays, or large B is
+        # condemned by data starvation instead of staleness
+        rng_acc = np.random.RandomState(17)
+        w_true = _planted_weights(rng_acc, DIMS)
+        train = _planted_workload(rng_acc, 1 << 17, DIMS, w_true)
+        holdout = _planted_workload(rng_acc, 1 << 14, DIMS, w_true)
+        sweep = []
+        for b in BATCH_SWEEP:
+            plans = jax.tree_util.tree_map(
+                jax.device_put, stage_epoch_plans(idx[:4], b, DIMS))
+            bfn = make_batch_train_fn(AROW, {"r": 0.1}, batch_size=b)
+            epoch = make_epoch(lambda s, bi, bv, bl, pl: bfn(s, bi, bv, bl,
+                                                             pl))
+            rps = timed_epoch_loop(
+                epoch, init_linear_state(DIMS, use_covariance=True),
+                staged=(idx_d[:4], val_d[:4], lab_d[:4], plans),
+                budget_s=3.0)
+            sweep.append({
+                "batch_size": b,
+                "rows_per_sec": round(rps, 1),
+                "holdout_logloss": round(
+                    _batch_holdout_logloss(b, train, holdout, DIMS), 5),
+            })
+            print(f"bench: batch sweep B={b}: {rps:.0f} rows/s, "
+                  f"logloss {sweep[-1]['holdout_logloss']}",
+                  file=sys.stderr)
+        out["arow_batch_sweep"] = sweep
+        chosen = _pick_batch_size(sweep)
+        out["arow_batch_size"] = chosen
+
+        # (c) the batched headline at the chosen B over the full 128-block
+        # staged epoch — same workload and epoch shape as the minibatch
+        # number above, so the two rows of the scoreboard are paired
+        plans = jax.tree_util.tree_map(
+            jax.device_put, stage_epoch_plans(idx, chosen, DIMS))
+        bfn = make_batch_train_fn(AROW, {"r": 0.1}, batch_size=chosen)
+        epoch = make_epoch(lambda s, bi, bv, bl, pl: bfn(s, bi, bv, bl, pl))
+        out["arow_batch_rows_per_sec"] = round(timed_epoch_loop(
+            epoch, init_linear_state(DIMS, use_covariance=True),
+            staged=(idx_d, val_d, lab_d, plans)), 1)
+
+        # (d) cache-pressure regime (standing, not a smoke note): 2^24-dim
+        # tables (128 MB w+cov) push every gather/scatter past cache, the
+        # regime where bandwidth — the thing batching and int8 save — is
+        # the binding constraint
+        cp_blocks = 8
+        idx_cp = make_ids(rng, (cp_blocks, batch, WIDTH),
+                          CACHE_PRESSURE_DIMS)
+        cp_staged = (jnp.asarray(idx_cp),
+                     jnp.asarray(np.ones_like(idx_cp, dtype=np.float32)),
+                     lab_d[:cp_blocks])
+        out["arow_cache_pressure_minibatch_rows_per_sec"] = round(
+            timed_epoch_loop(
+                make_epoch(make_train_fn(AROW, {"r": 0.1},
+                                         mode="minibatch")),
+                init_linear_state(CACHE_PRESSURE_DIMS, use_covariance=True),
+                staged=cp_staged, budget_s=4.0), 1)
+        cp_plans = jax.tree_util.tree_map(
+            jax.device_put,
+            stage_epoch_plans(idx_cp, chosen, CACHE_PRESSURE_DIMS))
+        cp_fn = make_batch_train_fn(AROW, {"r": 0.1}, batch_size=chosen)
+        cp_epoch = make_epoch(lambda s, bi, bv, bl, pl: cp_fn(s, bi, bv, bl,
+                                                              pl))
+        out["arow_cache_pressure_batch_rows_per_sec"] = round(
+            timed_epoch_loop(
+                cp_epoch,
+                init_linear_state(CACHE_PRESSURE_DIMS, use_covariance=True),
+                staged=cp_staged + (cp_plans,), budget_s=4.0), 1)
+
+        # (e) the framework's host execution backend (-native_scan): exact
         # sequential epochs through the C row loop over the same staged
         # blocks — what an accelerator-less deployment actually runs
         from hivemall_tpu import native
@@ -201,6 +367,104 @@ def _measure() -> None:
             out["arow_native_scan_rows_per_sec"] = round(
                 total / (time.perf_counter() - t0), 1)
     print(json.dumps(out))
+
+
+def batch_smoke() -> int:
+    """Tier-1 gate (scripts/test.sh gate 8): the batched backend must beat
+    the row-serial JAX scan on THIS host by >= BATCH_SMOKE_MIN_VS_SCAN at
+    a batch size whose holdout logloss stays within the pinned parity
+    tolerance of B=1. Small shapes (2^20 dims) so the gate runs in tens
+    of seconds; the full-size numbers live in the main bench line. Runs
+    in-process on the CPU backend and prints ONE BENCH-style JSON line."""
+    import jax
+    import jax.numpy as jnp
+
+    from hivemall_tpu.core.batch_update import (make_batch_train_fn,
+                                                stage_epoch_plans)
+    from hivemall_tpu.core.engine import make_epoch, make_train_fn
+    from hivemall_tpu.core.state import init_linear_state
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.runtime.benchmark import honest_timed_loop
+
+    platform = jax.devices()[0].platform
+    if platform != "cpu":
+        print(json.dumps({"metric": "arow_batch_vs_scan_speedup",
+                          "value": 0.0, "skipped": f"platform={platform}"}))
+        return 0
+
+    dims = 1 << 20
+    block, n_blocks, smoke_b = 8192, 4, 2048
+    rng = np.random.RandomState(0)
+    idx = make_ids(rng, (n_blocks, block, WIDTH), dims)
+    val = np.ones((n_blocks, block, WIDTH), np.float32)
+    lab = np.sign(rng.randn(n_blocks, block)).astype(np.float32)
+    idx_d, val_d, lab_d = jnp.asarray(idx), jnp.asarray(val), \
+        jnp.asarray(lab)
+
+    def rps(epoch, staged, budget_s=3.0):
+        st = init_linear_state(dims, use_covariance=True)
+        st, losses = epoch(st, *staged)
+        jax.block_until_ready(losses)
+        rows = int(staged[0].shape[0]) * int(staged[0].shape[1])
+
+        def run(s):
+            s2, _ = epoch(s, *staged)
+            return s2
+
+        iters, secs, _ = honest_timed_loop(run, st, lambda s: float(s.step),
+                                           budget_s=budget_s,
+                                           expect_probe_delta=rows)
+        return iters * rows / secs
+
+    scan_rps = rps(make_epoch(make_train_fn(AROW, {"r": 0.1}, mode="scan")),
+                   (idx_d[:1], val_d[:1], lab_d[:1]))
+    plans = jax.tree_util.tree_map(
+        jax.device_put, stage_epoch_plans(idx, smoke_b, dims))
+    bfn = make_batch_train_fn(AROW, {"r": 0.1}, batch_size=smoke_b)
+    batch_rps = rps(make_epoch(lambda s, bi, bv, bl, pl:
+                               bfn(s, bi, bv, bl, pl)),
+                    (idx_d, val_d, lab_d, plans))
+    speedup = batch_rps / scan_rps if scan_rps else 0.0
+
+    # 2^16 rows -> 32 updates at the smoke B: the smallest scale where
+    # batch-count starvation doesn't masquerade as staleness
+    rng_acc = np.random.RandomState(5)
+    w_true = _planted_weights(rng_acc, dims)
+    train = _planted_workload(rng_acc, 1 << 16, dims, w_true)
+    holdout = _planted_workload(rng_acc, 1 << 13, dims, w_true)
+    ll_b1 = _batch_holdout_logloss(1, train, holdout, dims)
+    ll_b = _batch_holdout_logloss(smoke_b, train, holdout, dims)
+    ll_delta = abs(ll_b - ll_b1)
+
+    ok_speed = speedup >= BATCH_SMOKE_MIN_VS_SCAN
+    ok_parity = ll_delta <= BATCH_PARITY_TOL_LOGLOSS
+    print(json.dumps({
+        "metric": "arow_batch_vs_scan_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "platform": platform,
+        "methodology": {"name": "batch_smoke_2^20dims_32nnz",
+                        "execution_backend": "batch",
+                        "batch_size": smoke_b,
+                        "score_calibration": "std"},
+        "scan_rows_per_sec": round(scan_rps, 1),
+        "batch_rows_per_sec": round(batch_rps, 1),
+        "min_speedup": BATCH_SMOKE_MIN_VS_SCAN,
+        "holdout_logloss_b1": round(ll_b1, 5),
+        "holdout_logloss_batch": round(ll_b, 5),
+        "logloss_delta": round(ll_delta, 5),
+        "parity_tol_logloss": BATCH_PARITY_TOL_LOGLOSS,
+        "pass": bool(ok_speed and ok_parity),
+    }))
+    if not ok_speed:
+        print(f"batch-smoke FAIL: batched {batch_rps:.0f} rows/s is only "
+              f"{speedup:.2f}x the row-serial scan ({scan_rps:.0f}); gate "
+              f"needs >= {BATCH_SMOKE_MIN_VS_SCAN}x", file=sys.stderr)
+    if not ok_parity:
+        print(f"batch-smoke FAIL: holdout logloss moved {ll_b1:.4f} -> "
+              f"{ll_b:.4f} at B={smoke_b} (tol "
+              f"{BATCH_PARITY_TOL_LOGLOSS})", file=sys.stderr)
+    return 0 if (ok_speed and ok_parity) else 1
 
 
 def _run_child(env_overrides: dict, timeout: float):
@@ -405,53 +669,131 @@ def main() -> None:
                         ESTIMATED_JVM_MAPPER_ROWS_PER_SEC)
     fm_anchor = float(anchors.get("fm_rows_per_sec") or
                       ESTIMATED_JVM_MAPPER_ROWS_PER_SEC)
-    print(json.dumps({
-        "metric": "arow_train_throughput_2^22dims_32nnz",
-        "value": arow,
+
+    def _meth(backend, batch_size=None, name="hbm_staged_device_scan_epoch",
+              **extra):
+        # methodology is structured since round 6 so rounds stay comparable
+        # across execution backends: `name` keeps the historical string,
+        # `execution_backend` names the ladder rung (scan / native_scan /
+        # minibatch / batch<B> / mxu / pallas), batch_size pins B
+        m = {"name": name, "execution_backend": backend}
+        if batch_size is not None:
+            m["batch_size"] = int(batch_size)
+        m.update(extra)
+        return m
+
+    chosen_b = raw.get("arow_batch_size")
+    batch_rps = float(raw.get("arow_batch_rows_per_sec") or 0.0)
+    # the headline is the framework's best parity-passing CPU path: the
+    # batched backend at the swept B when it wins, else the historical
+    # minibatch number (TPU rounds keep minibatch — the relay path)
+    headline, headline_meth = arow, _meth("minibatch")
+    if batch_rps > arow:
+        headline = batch_rps
+        headline_meth = _meth("batch", chosen_b,
+                              score_calibration="std",
+                              logloss_parity_tol=BATCH_PARITY_TOL_LOGLOSS)
+    extra = [{
+        "metric": f"fm_train_throughput_2^22dims_k{FM_FACTORS}_32nnz",
+        "value": fm,
         "unit": "rows/sec",
-        "vs_baseline": round(arow / arow_anchor, 3) if arow_anchor else 0.0,
-        "platform": raw.get("platform", "none"),
-        "device_set": raw.get("device_set"),
-        "methodology": "hbm_staged_device_scan_epoch",
-        "baseline_anchor": anchors,
+        "methodology": _meth("minibatch"),
+        "vs_baseline": round(fm / fm_anchor, 3) if fm_anchor else 0.0,
         "vs_estimated_jvm_mapper": round(
-            arow / ESTIMATED_JVM_MAPPER_ROWS_PER_SEC, 3),
-        "extra_metrics": [{
-            "metric": f"fm_train_throughput_2^22dims_k{FM_FACTORS}_32nnz",
-            "value": fm,
-            "unit": "rows/sec",
-            "vs_baseline": round(fm / fm_anchor, 3) if fm_anchor else 0.0,
-            "vs_estimated_jvm_mapper": round(
-                fm / ESTIMATED_JVM_MAPPER_ROWS_PER_SEC, 3),
-        }] + [{
-            # sorted-window MXU update backend A/B (ops/mxu_scatter.py)
-            "metric": m,
-            "methodology": "hbm_staged_device_scan_epoch_mxu_backend",
-            "value": float(raw[k]),
-            "unit": "rows/sec",
-            "vs_baseline": round(float(raw[k]) / a, 3) if a else 0.0,
-        } for m, k, a in [
-            ("arow_train_throughput_2^22dims_32nnz",
-             "arow_mxu_rows_per_sec", arow_anchor),
-            (f"fm_train_throughput_2^22dims_k{FM_FACTORS}_32nnz",
-             "fm_mxu_rows_per_sec", fm_anchor),
-        ] if raw.get(k)] + ([{
-            # the -native_scan host backend over the same staged blocks:
-            # what an accelerator-less deployment runs; ~= the anchor by
-            # construction (same loop), so vs_baseline ~ 1.0 is expected
+            fm / ESTIMATED_JVM_MAPPER_ROWS_PER_SEC, 3),
+    }]
+    if batch_rps > arow:
+        # keep the historical minibatch row when the batched path headlines
+        extra.append({
             "metric": "arow_train_throughput_2^22dims_32nnz",
-            "methodology": "native_scan_host_backend",
+            "methodology": _meth("minibatch"),
+            "value": arow,
+            "unit": "rows/sec",
+            "vs_baseline": round(arow / arow_anchor, 3)
+            if arow_anchor else 0.0,
+        })
+    for key, backend, bs in (
+            ("arow_scan_rows_per_sec", "scan", None),
+            ("arow_batch_rows_per_sec", "batch", chosen_b)):
+        if raw.get(key) and not (key == "arow_batch_rows_per_sec"
+                                 and batch_rps > arow):
+            extra.append({
+                "metric": "arow_train_throughput_2^22dims_32nnz",
+                "methodology": _meth(backend, bs),
+                "value": float(raw[key]),
+                "unit": "rows/sec",
+                "vs_baseline": round(float(raw[key]) / arow_anchor, 3)
+                if arow_anchor else 0.0,
+            })
+    for key, backend in (
+            ("arow_cache_pressure_minibatch_rows_per_sec", "minibatch"),
+            ("arow_cache_pressure_batch_rows_per_sec", "batch")):
+        if raw.get(key):
+            extra.append({
+                "metric": "arow_train_throughput_2^24dims_32nnz",
+                "regime": "cache_pressure",
+                "methodology": _meth(
+                    backend, chosen_b if backend == "batch" else None),
+                "value": float(raw[key]),
+                "unit": "rows/sec",
+            })
+    extra += [{
+        # sorted-window MXU update backend A/B (ops/mxu_scatter.py)
+        "metric": m,
+        "methodology": _meth("mxu"),
+        "value": float(raw[k]),
+        "unit": "rows/sec",
+        "vs_baseline": round(float(raw[k]) / a, 3) if a else 0.0,
+    } for m, k, a in [
+        ("arow_train_throughput_2^22dims_32nnz",
+         "arow_mxu_rows_per_sec", arow_anchor),
+        (f"fm_train_throughput_2^22dims_k{FM_FACTORS}_32nnz",
+         "fm_mxu_rows_per_sec", fm_anchor),
+    ] if raw.get(k)]
+    if raw.get("arow_native_scan_rows_per_sec"):
+        # the -native_scan host backend over the same staged blocks:
+        # what an accelerator-less deployment runs; ~= the anchor by
+        # construction (same loop), so vs_baseline ~ 1.0 is expected
+        extra.append({
+            "metric": "arow_train_throughput_2^22dims_32nnz",
+            "methodology": _meth("native_scan",
+                                 name="native_scan_host_backend"),
             "value": float(raw["arow_native_scan_rows_per_sec"]),
             "unit": "rows/sec",
             "vs_baseline": round(
                 float(raw["arow_native_scan_rows_per_sec"]) / arow_anchor,
                 3) if arow_anchor else 0.0,
-        }] if raw.get("arow_native_scan_rows_per_sec") else []),
-    }))
+        })
+    payload = {
+        "metric": "arow_train_throughput_2^22dims_32nnz",
+        "value": headline,
+        "unit": "rows/sec",
+        "vs_baseline": round(headline / arow_anchor, 3)
+        if arow_anchor else 0.0,
+        "platform": raw.get("platform", "none"),
+        "device_set": raw.get("device_set"),
+        "methodology": headline_meth,
+        "baseline_anchor": anchors,
+        "vs_estimated_jvm_mapper": round(
+            headline / ESTIMATED_JVM_MAPPER_ROWS_PER_SEC, 3),
+        "extra_metrics": extra,
+    }
+    if raw.get("arow_batch_sweep"):
+        # the AdaBatch study rides the same line: every B's throughput AND
+        # holdout logloss, so the chosen default is auditable in-artifact
+        payload["batch_sweep"] = {
+            "entries": raw["arow_batch_sweep"],
+            "chosen_batch_size": chosen_b,
+            "parity_tol_logloss": BATCH_PARITY_TOL_LOGLOSS,
+            "score_calibration": "std",
+        }
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _measure()
+    elif "--batch-smoke" in sys.argv:
+        sys.exit(batch_smoke())
     else:
         main()

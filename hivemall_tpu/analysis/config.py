@@ -137,7 +137,13 @@ DTYPEFLOW_HOT_MODULES = ("hivemall_tpu/serving/engine.py",
                          # (G019) and f32 accumulation (G021), same
                          # contract as the single-device _q8_* scorers
                          "hivemall_tpu/serving/sharded.py",
-                         "hivemall_tpu/io/checkpoint.py")
+                         "hivemall_tpu/io/checkpoint.py",
+                         # the segment-sum batched trainer: the CPU hot
+                         # path — gathered [U]-window widens only, f32
+                         # delta accumulation, one cast at each table
+                         # write; a full-table promotion here would hand
+                         # back the bandwidth the compact plan bought
+                         "hivemall_tpu/core/batch_update.py")
 HOT_MARKER = "# graftcheck: hot-module"
 
 # G018 scope: the serving/request path plus checkpoint IO — np.float64 (or a

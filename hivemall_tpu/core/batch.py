@@ -118,6 +118,29 @@ def iter_blocks(
         )
 
 
+def pad_rows_to_multiple(indices, values, labels, multiple: int, dims: int):
+    """Pad a staged block's rows up to a multiple of `multiple` with
+    sentinel rows (every lane the out-of-range pad index ``dims``, value 0,
+    label 0) — the fixed-chunk scan shape shared by the chunked device
+    backends (kernels/linear_scan.py's SMEM chunking; the batch backend
+    stages a tail plan instead, core/batch_update.py). Sentinel rows are
+    dead weight only: backends that carry global scalars or the example
+    counter must mask by the TRUE row count (linear_scan's live_rows
+    meta) — a sentinel row is not a no-op for running scalar stats."""
+    import jax.numpy as jnp
+
+    b, k = indices.shape
+    b_pad = (b + multiple - 1) // multiple * multiple
+    if b_pad == b:
+        return indices, values, labels
+    pad = b_pad - b
+    return (
+        jnp.concatenate([indices, jnp.full((pad, k), dims, indices.dtype)]),
+        jnp.concatenate([values, jnp.zeros((pad, k), values.dtype)]),
+        jnp.concatenate([labels, jnp.zeros((pad,), labels.dtype)]),
+    )
+
+
 def shuffle_rows(
     idx_rows: List[np.ndarray],
     val_rows: List[np.ndarray],

@@ -92,6 +92,14 @@ class Rule:
     # sums), "mean" for decayed/EMA statistics (AdaDelta). Unlisted slots
     # default to "mean" over the replicas that touched the feature.
     slot_merge: Tuple[Tuple[str, str], ...] = ()
+    # Batch-aware variant of `update`: same closed form applied to a whole
+    # minibatch context at once (ctx fields carry a leading [B] axis —
+    # w/cov/val [B, K], y/score/sq_norm/variance/t [B]) with the row-axis
+    # broadcasts written out explicitly. Optional: rules without one run
+    # the per-row update under vmap (identical math; the explicit form
+    # exists because the batched backend is the CPU hot path and the
+    # traced program stays smaller without the vmap batching pass).
+    batch_update: Optional[Callable[["RowContext", dict], "RuleOutput"]] = None
 
 
 def _gather(table: jnp.ndarray, idx: jnp.ndarray, fill: float = 0.0) -> jnp.ndarray:
@@ -122,6 +130,44 @@ def _row_ctx(state_tables, idx, val, y, t, use_cov, globals_=None, packed=None):
 
 DELTA_SLOT = "__delta_upd"  # per-feature update count since the last mix —
 # the TPU analog of DenseModel's deltaUpdates byte array (ref: DenseModel.java:52)
+
+
+def make_batch_update(rule: Rule, hyper: dict):
+    """Batch-aware application of a Rule: one call over a whole minibatch.
+
+    Returns `apply(w, cov, sl, val, y, ts, gl) -> RuleOutput` where w/cov/
+    val are [B, K], sl maps slot name -> [B, K], y/ts are [B] and gl is the
+    rule's scalar globals dict. Uses `rule.batch_update` when the rule
+    ships an explicit batch form, else vmaps the per-row update — the two
+    are the same closed form, pinned equal by tests/test_batch_update.py.
+    """
+    use_cov = rule.use_covariance
+
+    if rule.batch_update is not None:
+        def apply(w, cov, sl, val, y, ts, gl):
+            score = jnp.sum(w * val, axis=-1)
+            sq_norm = jnp.sum(val * val, axis=-1)
+            variance = jnp.sum(cov * val * val, axis=-1) if use_cov \
+                else jnp.zeros_like(score)
+            ctx = RowContext(w, cov, sl, val, y, score, sq_norm, variance,
+                             ts, gl)
+            return rule.batch_update(ctx, hyper)
+
+        return apply
+
+    def apply(w, cov, sl, val, y, ts, gl):
+        def per_row(w_r, cov_r, sl_r, val_r, y_r, t_r):
+            score = jnp.sum(w_r * val_r)
+            sq_norm = jnp.sum(val_r * val_r)
+            variance = jnp.sum(cov_r * val_r * val_r) if use_cov \
+                else jnp.zeros(())
+            ctx = RowContext(w_r, cov_r, sl_r, val_r, y_r, score, sq_norm,
+                             variance, t_r, gl)
+            return rule.update(ctx, hyper)
+
+        return jax.vmap(per_row)(w, cov, sl, val, y, ts)
+
+    return apply
 
 
 def make_train_fn(

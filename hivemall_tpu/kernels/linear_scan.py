@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.batch import pad_rows_to_multiple
 from ..core.engine import Rule, RowContext
 from ..core.state import LinearState
 
@@ -211,13 +212,9 @@ def pallas_scan_raw(rule: Rule, hyper: dict, state: LinearState,
     d_pad = (D + LANES - 1) // LANES * LANES
     n_rows = d_pad // LANES
     chunk = _pick_chunk(B, K)
-    b_pad = (B + chunk - 1) // chunk * chunk
-    if b_pad != B:
-        pad = b_pad - B
-        indices = jnp.concatenate([indices, jnp.full((pad, K), D, jnp.int32)])
-        values = jnp.concatenate([values, jnp.zeros((pad, K), jnp.float32)])
-        labels = jnp.concatenate([labels, jnp.zeros((pad,), jnp.float32)])
-    n_chunks = b_pad // chunk
+    indices, values, labels = pad_rows_to_multiple(indices, values, labels,
+                                                   chunk, D)
+    n_chunks = indices.shape[0] // chunk
 
     kernel = _make_kernel(rule, hyper, K, D, chunk, slot_names, global_names)
 

@@ -74,6 +74,11 @@ def base_options() -> Options:
           "Route -mini_batch table updates through the sorted-window MXU "
           "gather/scatter (ops/mxu_scatter.py) instead of XLA's scalar "
           "scatter engine — same semantics, f32 sums up to addition order")
+    o.add("batch", "batch_backend", True,
+          "Segment-sum batched backend: apply minibatches of B rows "
+          "through one host-staged dedup plan (core/batch_update.py) — "
+          "the CPU hot path; same mini-batch semantics as -mini_batch B "
+          "(docs/execution_backends.md)", type=int)
     return o
 
 
@@ -245,9 +250,27 @@ def fit_linear(
         raise ValueError("no training rows")
     width = pad_to_bucket(max((len(r) for r in idx_rows), default=1))
 
+    batch_b = cl.get_int("batch", 0) if cl.has("batch") else 0
     mode = "minibatch" if mini_batch > 1 else "scan"
+    if cl.has("batch"):
+        if batch_b < 1:
+            raise ValueError(f"-batch must be >= 1: {batch_b}")
+        if mini_batch > 1:
+            raise ValueError("-batch IS the mini-batch backend; drop "
+                             "-mini_batch (its size becomes -batch's B)")
+        if cl.has("native_scan") or cl.has("pallas") \
+                or cl.has("mxu_scatter"):
+            raise ValueError("-batch does not compose with -native_scan/"
+                             "-pallas/-mxu_scatter; pick one execution "
+                             "backend (docs/execution_backends.md)")
+        mode = "batch"
     if mode == "minibatch":
         block_size = mini_batch
+    if mode == "batch":
+        # a staged block must hold whole minibatches: round the block up
+        # to a multiple of B (only the dataset's final partial block
+        # stages a tail chunk)
+        block_size = -(-max(block_size, batch_b) // batch_b) * batch_b
     if cl.has("native_scan"):
         if mode != "scan":
             raise ValueError("-native_scan is the exact per-row path; "
@@ -255,7 +278,11 @@ def fit_linear(
         return _fit_native_scan(rule, hyper, cl, dims, idx_rows, val_rows,
                                 labels, width, block_size,
                                 initial_weights, initial_covars)
-    if cl.has("pallas") and mode == "scan":
+    if mode == "batch":
+        from ..core.batch_update import make_batch_train_step
+
+        step = make_batch_train_step(rule, hyper, batch_size=batch_b)
+    elif cl.has("pallas") and mode == "scan":
         from ..kernels.linear_scan import make_pallas_scan_step
 
         interpret = jax.devices()[0].platform != "tpu"
@@ -290,18 +317,38 @@ def fit_linear(
 
     iter_counter = REGISTRY.counter("hivemall", f"{rule.name}.iterations")
     row_counter = REGISTRY.counter("hivemall", f"{rule.name}.examples")
+    # -batch: plans are a pure function of each block's indices, so they
+    # are staged on the host once and replayed every epoch (cleared when
+    # -shuffle re-deals the rows)
+    plan_cache: list = []
     for it in range(max(1, iters)):
         if cl.has("shuffle") and it > 0:
             idx_rows, val_rows, labels = shuffle_rows(
                 idx_rows, val_rows, labels, cl.get_int("seed", 31) + it
             )
+            plan_cache = []
         # losses stay on device through the epoch — a float() per block
         # would sync the dispatch stream every step; the convergence check
         # only needs the epoch total, fetched in ONE batched device_get at
         # the epoch boundary (graftcheck G002)
         epoch_losses = []
-        for block in iter_blocks(idx_rows, val_rows, labels, dims, block_size, width):
-            state, loss = step(state, block.indices, block.values, block.labels)
+        for bi, block in enumerate(
+                iter_blocks(idx_rows, val_rows, labels, dims, block_size,
+                            width)):
+            if mode == "batch":
+                from ..core.batch_update import stage_block_plans
+
+                if bi >= len(plan_cache):
+                    # device_put once at staging: replayed epochs must not
+                    # re-upload the plan arrays every block
+                    plan_cache.append(jax.tree_util.tree_map(
+                        jax.device_put,
+                        stage_block_plans(block.indices, batch_b, dims)))
+                state, loss = step(state, block.indices, block.values,
+                                   block.labels, plan_cache[bi])
+            else:
+                state, loss = step(state, block.indices, block.values,
+                                   block.labels)
             epoch_losses.append(loss)
             row_counter.increment(block.batch_size)
         iter_counter.increment()

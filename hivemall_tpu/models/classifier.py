@@ -64,7 +64,16 @@ def _perceptron_update(ctx, hyper):
     return RuleOutput(dw=dw, loss=loss, updated=updated)
 
 
-PERCEPTRON = Rule("perceptron", _perceptron_update)
+def _perceptron_batch_update(ctx, hyper):
+    # the same closed form with the [B] -> [B, K] broadcasts explicit
+    updated = ctx.y * ctx.score <= 0.0  # [B]
+    dw = jnp.where(updated[:, None], ctx.y[:, None] * ctx.val, 0.0)
+    loss = jnp.where(updated, 1.0, 0.0)
+    return RuleOutput(dw=dw, loss=loss, updated=updated)
+
+
+PERCEPTRON = Rule("perceptron", _perceptron_update,
+                  batch_update=_perceptron_batch_update)
 
 
 # ------------------------------------------------------------------- PA family
@@ -111,7 +120,26 @@ def _cw_update(ctx, hyper):
     return RuleOutput(dw=dw, loss=loss, updated=updated, dcov=dcov)
 
 
-CW = Rule("cw", _cw_update, use_covariance=True)
+def _cw_batch_update(ctx, hyper):
+    # _cw_update's closed form over a whole [B, K] minibatch
+    phi = hyper["phi"]
+    score = ctx.score * ctx.y  # [B]
+    var = ctx.variance  # [B]
+    b = 1.0 + 2.0 * phi * score
+    disc = jnp.maximum(0.0, b * b - 8.0 * phi * (score - phi * var))
+    gamma = _safe_div(-b + jnp.sqrt(disc), 4.0 * phi * var)
+    updated = gamma > 0.0
+    alpha = jnp.where(updated, gamma, 0.0)
+    coeff = (alpha * ctx.y)[:, None]
+    dw = coeff * ctx.cov * ctx.val
+    denom = 1.0 + 2.0 * alpha[:, None] * phi * ctx.val * ctx.val * ctx.cov
+    dcov = ctx.cov / denom - ctx.cov
+    loss = jnp.where(ctx.score * ctx.y < 0.0, 1.0, 0.0)
+    return RuleOutput(dw=dw, loss=loss, updated=updated, dcov=dcov)
+
+
+CW = Rule("cw", _cw_update, use_covariance=True,
+          batch_update=_cw_batch_update)
 
 
 # ------------------------------------------------------------------------ AROW
@@ -138,8 +166,35 @@ def _arow_update_factory(hinge: bool):
     return update
 
 
-AROW = Rule("arow", _arow_update_factory(False), use_covariance=True)
-AROWH = Rule("arowh", _arow_update_factory(True), use_covariance=True)
+def _arow_batch_update_factory(hinge: bool):
+    def update(ctx, hyper):
+        # the row update's closed form over a whole [B, K] minibatch: row
+        # scalars stay [B], the per-lane broadcasts are written out (the
+        # batched backend's hot path, core/batch_update.py)
+        r = hyper["r"]
+        m = ctx.score * ctx.y  # [B]
+        if hinge:
+            loss = jnp.maximum(0.0, hyper["c"] - m)
+            updated = loss > 0.0
+            alpha_scale = loss
+        else:
+            updated = m < 1.0
+            alpha_scale = 1.0 - m
+            loss = jnp.where(m < 0.0, 1.0, 0.0)
+        beta = 1.0 / (ctx.variance + r)  # [B]
+        alpha = jnp.where(updated, alpha_scale * beta, 0.0)
+        cv = ctx.cov * ctx.val  # [B, K]
+        dw = (ctx.y * alpha)[:, None] * cv
+        dcov = jnp.where(updated[:, None], -beta[:, None] * cv * cv, 0.0)
+        return RuleOutput(dw=dw, loss=loss, updated=updated, dcov=dcov)
+
+    return update
+
+
+AROW = Rule("arow", _arow_update_factory(False), use_covariance=True,
+            batch_update=_arow_batch_update_factory(False))
+AROWH = Rule("arowh", _arow_update_factory(True), use_covariance=True,
+             batch_update=_arow_batch_update_factory(True))
 
 
 # ------------------------------------------------------------------- SCW1/SCW2

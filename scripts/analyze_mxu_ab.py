@@ -43,9 +43,11 @@ def main() -> None:
     verdicts = []
     for d in head:
         xla = d.get("value")
-        extras = {e.get("methodology", e["metric"]): e
-                  for e in d.get("extra_metrics", [])}
-        for em, e in extras.items():
+        # methodology is a structured dict since round 6 ({name,
+        # execution_backend, ...}; a plain string in older rounds) — match
+        # on its string form, never use it as a dict key
+        for e in d.get("extra_metrics", []):
+            em = e.get("methodology", e["metric"])
             if "mxu" in str(em):
                 print(f"bench.py AROW: xla {xla:,.0f} rows/s vs mxu "
                       f"{e['value']:,.0f} -> "

@@ -72,4 +72,12 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --overload --smoke
 
+# tier-1 gate 8: batched-backend smoke — the segment-sum batch path
+# (-batch B, core/batch_update.py) must beat the row-serial JAX scan on
+# this host by >= 1.5x AND hold the holdout-logloss parity tolerance at
+# the smoke batch size (docs/execution_backends.md; prints one
+# BENCH-style JSON line)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python bench.py --batch-smoke
+
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
