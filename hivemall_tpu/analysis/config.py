@@ -96,8 +96,12 @@ MUTATOR_METHODS = ("append", "appendleft", "extend", "insert", "add",
 
 # G013 scope: the serving hot path — a blocking call under a lock here stalls
 # every in-flight request at once (the hot-swap-stall failure mode). Modules
-# outside the list opt in with the marker comment.
+# outside the list opt in with the marker comment. The continuous-training
+# pipeline is in scope by prefix: its worker thread shares the registry with
+# request handlers, so a freeze/gate/deploy under its lock would stall
+# every concurrent status()/lineage read exactly when a swap is in flight.
 CONCURRENCY_HOT_PREFIXES = ("hivemall_tpu/serving/",
+                            "hivemall_tpu/pipeline/",
                             "hivemall_tpu/runtime/metrics")
 CONCURRENCY_MARKER = "# graftcheck: serving-module"
 

@@ -57,12 +57,18 @@ class ModelEntry:
     """One deployed model version: engine + its batching front."""
 
     def __init__(self, name: str, version: str, engine: ServingEngine,
-                 batcher: DynamicBatcher) -> None:
+                 batcher: DynamicBatcher,
+                 lineage: Optional[list] = None) -> None:
         self.name = name
         self.version = version
         self.engine = engine
         self.batcher = batcher
         self.deployed_unix = time.time()
+        # version lineage: the publisher's recent gate decisions (publish /
+        # refusal / rollback records — hivemall_tpu/pipeline) surfaced on
+        # /models, so "why is v7 serving and where did v6 go" is answerable
+        # from the serving endpoint alone. Immutable after deploy.
+        self.lineage = list(lineage or [])
 
     def describe(self) -> dict:
         return {
@@ -87,6 +93,9 @@ class ModelEntry:
             # quota fractions, live AIMD controller window, drain-rate
             # estimate and shed/expiry/quota-reject counters
             "admission": self.batcher.overload_state(),
+            # publisher lineage: recent gate decisions for this model's
+            # version sequence (empty for hand-deployed models)
+            "lineage": [dict(d) for d in self.lineage],
         }
 
 
@@ -139,6 +148,7 @@ class ModelRegistry:
 
     def deploy(self, name: str, source, version: Optional[str] = None,
                batcher_overrides: Optional[dict] = None,
+               lineage: Optional[list] = None,
                **engine_overrides) -> ModelEntry:
         """Deploy `source` (artifact dir path, Artifact, or trained model)
         as `name`; replaces any current version atomically AFTER the new
@@ -149,7 +159,10 @@ class ModelRegistry:
         this model's admission posture (max_queue_rows, quota fractions,
         adaptive caps, starvation limit) over the registry defaults —
         per-model quotas are per-model BATCHERS: each model owns its
-        queue, so one model's flood can never 503 another."""
+        queue, so one model's flood can never 503 another. ``lineage``
+        attaches the publisher's gate-decision records to the entry
+        (surfaced on /models — the continuous-training pipeline passes its
+        recent publish/refusal/rollback history here)."""
         from .artifact import Artifact, load as load_artifact
 
         if isinstance(source, str):
@@ -177,7 +190,8 @@ class ModelRegistry:
                    express_high=self.express_high)
         bkw.update(batcher_overrides or {})
         batcher = DynamicBatcher(engine.predict, name=name, **bkw)
-        entry = ModelEntry(name, str(version), engine, batcher)
+        entry = ModelEntry(name, str(version), engine, batcher,
+                           lineage=lineage)
         with self._lock:
             old = self._entries.get(name)
             self._entries[name] = entry  # the atomic publish

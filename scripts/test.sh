@@ -80,4 +80,14 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python bench.py --batch-smoke
 
+# tier-1 gate 9: continuous-training pipeline smoke — the stream ->
+# freeze -> eval gate -> hot-swap loop must land >= 3 gated publishes
+# (>= 2 atomic hot-swaps) under concurrent traffic with ZERO failed
+# in-flight requests, REFUSE the publish trained on the injected
+# label-flip regression, and keep end-to-end freshness p99 (event
+# observed -> model serving it) under the pinned bound
+# (docs/continuous_training.md; prints one BENCH-style JSON line)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_pipeline.py --smoke
+
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

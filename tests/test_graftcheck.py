@@ -502,6 +502,11 @@ def test_serving_and_runtime_are_concurrency_clean():
     lock-free reads are suppressed with a justification, and nothing
     hides in the baseline (no G012-G016 entries there either)."""
     paths = [os.path.join(PKG, "serving"),
+             # the continuous-training pipeline (PR 12): its worker thread
+             # spawns under a registry shared with request handlers — the
+             # freeze/gate/publish machinery must never block under the
+             # status lock
+             os.path.join(PKG, "pipeline"),
              os.path.join(PKG, "runtime", "metrics.py"),
              os.path.join(PKG, "runtime", "metrics_http.py"),
              # the tracer rides the serving hot path (opts into G013 with

@@ -1,0 +1,544 @@
+"""Continuous-training pipeline (hivemall_tpu/pipeline/): stream ->
+freeze -> eval gate -> hot-swap, with end-to-end freshness.
+
+Pins, per docs/continuous_training.md:
+
+- the drift stream is deterministic and replayable (pure function of
+  (seed, index); phases rotate piecewise; the label-flip poison window
+  only touches training labels);
+- eval-gate edges: first publish with no incumbent; regression refusal
+  keeps the OLD version serving; insufficient holdout refuses; rollback
+  on post-publish health degradation redeploys the previous version;
+- chaos: a PR 8 FaultPlan (crash_mid_write + corrupt) firing mid-pipeline
+  never publishes a corrupt artifact and the loop self-heals from the
+  last valid checkpoint with ZERO lost work vs an uninterrupted run;
+- a rotted frozen artifact (the artifact_frozen chaos seam) is refused at
+  the gate with reason ``artifact_corrupt`` and never reaches the
+  registry;
+- checkpoint resume continues the version sequence and republishes the
+  last published version into a fresh registry;
+- freshness: every observed event ends up covered by a published model,
+  samples land in the ``pipeline.<name>.freshness_seconds`` histogram.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.dataset.lr_datagen import DriftStream
+
+DIMS = 2048
+
+
+def _stream(tmp_seed=7, **kw):
+    kw.setdefault("drift_every", 10**9)
+    return DriftStream(DIMS, batch=64, width=8, seed=tmp_seed, **kw)
+
+
+def _cfg(root, **kw):
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.pipeline import PipelineConfig
+
+    base = dict(artifact_root=str(root), dims=DIMS, rule=AROW,
+                hyper={"r": 0.1}, name="ctr", freeze_every_events=512,
+                checkpoint_every_events=256, min_holdout_rows=64)
+    base.update(kw)
+    return PipelineConfig(**base)
+
+
+def _registry():
+    from hivemall_tpu.serving.server import ModelRegistry
+
+    return ModelRegistry(max_batch=64, max_delay_ms=1.0,
+                         engine_kwargs={"max_width": 32})
+
+
+# --- the stream ----------------------------------------------------------
+
+
+def test_drift_stream_is_deterministic_and_replayable():
+    a, b = _stream(), _stream()
+    for i in (0, 3, 17):
+        for x, y in zip(a.block(i), b.block(i)):
+            np.testing.assert_array_equal(x, y)
+    # replay out of order: block(5) after block(9) is still block(5)
+    i5 = a.block(9) and a.block(5)
+    np.testing.assert_array_equal(i5[0], b.block(5)[0])
+
+
+def test_drift_stream_rotates_piecewise():
+    s = DriftStream(DIMS, batch=32, width=8, seed=3, drift_every=256,
+                    drift_angle=0.5)
+    w0, w1 = s.w_true(0), s.w_true(1)
+    assert s.phase_of(255) == 0 and s.phase_of(256) == 1
+    # constant within a phase, rotated across phases (unit-cos ~ 0.878)
+    np.testing.assert_array_equal(s.w_true(0), w0)
+    cos = float(np.dot(w0, w1) / (np.linalg.norm(w0) * np.linalg.norm(w1)))
+    assert abs(cos - np.cos(0.5)) < 1e-4
+    # labels actually follow the phase concept: the phase-0 concept scores
+    # phase-0 blocks well above chance, later-phase blocks worse
+    idx, val, lab = s.clean_block(0)
+    m = np.sum(w0[idx] * val, axis=-1)
+    agree0 = np.mean(np.sign(m) == lab)
+    idx9, val9, lab9 = s.clean_block(48)  # phase 6 = 3 rad: near-antipodal
+    m9 = np.sum(w0[idx9] * val9, axis=-1)
+    assert agree0 > 0.8 > np.mean(np.sign(m9) == lab9) + 0.1
+
+
+def test_label_flip_window_poisons_training_labels_only():
+    s = DriftStream(DIMS, batch=32, width=8, seed=3,
+                    label_flip_events=(32, 64))
+    ci, cv, cl = s.clean_block(1)
+    pi, pv, pl = s.block(1)
+    np.testing.assert_array_equal(ci, pi)
+    np.testing.assert_array_equal(cl, -pl)  # whole block inside the window
+    np.testing.assert_array_equal(s.block(0)[2], s.clean_block(0)[2])
+
+
+# --- holdout + gate units ------------------------------------------------
+
+
+def test_rolling_holdout_routes_and_bounds():
+    from hivemall_tpu.pipeline import RollingHoldout
+
+    h = RollingHoldout(capacity_rows=64, every=4)
+    assert not h.routes_here(0)  # batch 0 always trains
+    assert h.routes_here(1) and not h.routes_here(2) and h.routes_here(5)
+    for i in range(5):
+        h.add(np.full((32, 8), i, np.int32), np.ones((32, 8), np.float32),
+              np.ones(32, np.float32))
+    assert h.rows == 64  # capacity bound: oldest batches aged out
+    idx_rows, val_rows, labels = h.snapshot()
+    assert len(labels) == 64 and len(idx_rows) == 64
+    assert int(idx_rows[0][0]) == 3  # batches 0-2 evicted
+
+
+class _StubEngine:
+    def __init__(self, margins):
+        self._m = np.asarray(margins, np.float32)
+
+    def predict(self, instances):
+        return self._m
+
+
+def _snapshot(n=128, seed=0):
+    r = np.random.RandomState(seed)
+    return ([r.randint(0, DIMS, 8).astype(np.int64) for _ in range(n)],
+            [r.rand(8).astype(np.float32) for _ in range(n)],
+            np.where(r.rand(n) > 0.5, 1.0, -1.0).astype(np.float32))
+
+
+def test_gate_first_publish_and_insufficient_holdout_and_regression():
+    from hivemall_tpu.pipeline import EvalGate
+
+    gate = EvalGate(regression_tol_logloss=0.005, min_holdout_rows=64)
+    snap = _snapshot()
+    labels = snap[2]
+    good = _StubEngine(labels * 3.0)  # perfectly aligned margins
+    bad = _StubEngine(-labels * 3.0)
+
+    d = gate.evaluate("1", good, None, snap)
+    assert d.published and d.reason == "first_publish"
+    assert d.candidate_logloss is not None
+
+    # no incumbent and NO holdout still publishes (serving something
+    # beats serving nothing)
+    d0 = gate.evaluate("1", good, None, None)
+    assert d0.published and d0.holdout_rows == 0
+
+    # with an incumbent, a starved holdout refuses — never swap blind
+    tiny = (snap[0][:8], snap[1][:8], labels[:8])
+    d1 = gate.evaluate("2", good, good, tiny, incumbent_version="1")
+    assert not d1.published and d1.reason == "insufficient_holdout"
+
+    # regression refuses; improvement publishes
+    d2 = gate.evaluate("2", bad, good, snap, incumbent_version="1")
+    assert not d2.published and d2.reason == "regression"
+    assert d2.candidate_logloss > d2.incumbent_logloss
+    d3 = gate.evaluate("2", good, bad, snap, incumbent_version="1")
+    assert d3.published and d3.reason == "improved_or_equal"
+
+
+# --- the loop end to end -------------------------------------------------
+
+
+def test_pipeline_first_publish_then_gated_swaps_with_lineage(tmp_path):
+    from hivemall_tpu.pipeline import ContinuousPipeline
+    from hivemall_tpu.runtime.metrics import REGISTRY
+
+    reg = _registry()
+    stream = _stream()
+    p = ContinuousPipeline(reg, stream.block, _cfg(tmp_path))
+    rep = p.run(40)  # 2560 events -> 5 cycles
+    assert rep["fatal"] is None
+    assert rep["publishes"] >= 2
+    assert rep["decisions"][0]["reason"] == "first_publish"
+    entry = reg.get("ctr")
+    assert entry is not None
+    assert entry.version == rep["published_versions"][-1]
+    # lineage rides /models: the live entry's describe carries the gate
+    # decisions that produced it
+    lineage = entry.describe()["lineage"]
+    assert lineage and lineage[-1]["version"] == entry.version
+    assert any(d["reason"] == "first_publish" for d in lineage)
+    # freshness: every observed event was covered by a publish
+    assert rep["freshness_events"] == rep["events"]
+    assert rep["freshness"]["p99"] is not None
+    hist = REGISTRY.histogram("pipeline.ctr.freshness_seconds")
+    assert hist.count >= rep["freshness_samples"]
+
+
+def test_gate_refuses_poisoned_cycle_and_old_version_keeps_serving(
+        tmp_path):
+    from hivemall_tpu.pipeline import ContinuousPipeline
+
+    # poison window == exactly cycle 4 (events 1536..2048)
+    stream = _stream(label_flip_events=(1536, 2048))
+    reg = _registry()
+    p = ContinuousPipeline(reg, stream.block, _cfg(tmp_path))
+    rep = p.run(48)  # 3072 events -> 6 cycles
+    refused = [d for d in rep["decisions"]
+               if not d["published"] and d["reason"] == "regression"]
+    assert refused, rep["decisions"]
+    refused_versions = {d["version"] for d in refused}
+    # a refused version never serves: not in the published sequence and
+    # not the live version
+    assert not refused_versions & set(rep["published_versions"])
+    assert reg.get("ctr").version in rep["published_versions"]
+    # the cycle trained on the flipped window specifically was refused
+    poisoned = [d for d in rep["decisions"]
+                if d.get("trained_through_event") == 2047]
+    assert poisoned and not poisoned[0]["published"]
+
+
+def test_rollback_on_post_publish_health_degradation(tmp_path):
+    from hivemall_tpu.core.state import init_linear_state
+    from hivemall_tpu.models.base import TrainedLinearModel
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.pipeline import ContinuousPipeline
+    from hivemall_tpu.serving import artifact as serving_artifact
+
+    reg = _registry()
+    stream = _stream()
+    p = ContinuousPipeline(reg, stream.block, _cfg(tmp_path))
+    rep = p.run(24)
+    assert rep["publishes"] >= 1
+    good_version = reg.get("ctr").version
+
+    # a degraded version slips past the gate (simulating what a health
+    # check exists for): anti-correlated weights, force-deployed
+    bad_state = init_linear_state(
+        DIMS, use_covariance=True,
+        initial_weights=-np.asarray(
+            np.random.RandomState(0).randn(DIMS), np.float32))
+    bad = TrainedLinearModel(state=bad_state, rule=AROW, dims=DIMS,
+                             block_width=8)
+    bad_path = os.path.join(str(tmp_path), "ctr-v999")
+    serving_artifact.freeze(bad, bad_path, name="ctr", version="999")
+    reg.deploy("ctr", serving_artifact.load(bad_path), version="999")
+    with p._lock:
+        p._published.append({"version": "999", "path": bad_path,
+                             "trained_through": rep["events"] - 1,
+                             "gate_logloss": None})
+    p._maybe_rollback(p.holdout.snapshot())
+    st = p.status()
+    assert st["rollbacks"] == 1
+    assert reg.get("ctr").version == good_version
+    assert st["decisions"][-1]["reason"] == "rollback"
+    assert st["decisions"][-1]["rolled_back_version"] == "999"
+    # healthy live version does NOT trigger a second rollback
+    p._maybe_rollback(p.holdout.snapshot())
+    assert p.status()["rollbacks"] == 1
+
+
+def test_chaos_faults_mid_pipeline_self_heal_zero_lost_work(tmp_path):
+    """The chaos satellite: crash_mid_write kills a checkpoint write,
+    corrupt rots the next one and a transient fires right after — the
+    loop must restart from the last VALID checkpoint (loud .prev
+    fallback), replay the deterministic stream, publish only verified
+    artifacts, and end step-identical to an uninterrupted run."""
+    from hivemall_tpu.io.checkpoint import load_elastic
+    from hivemall_tpu.pipeline import ContinuousPipeline
+    from hivemall_tpu.runtime import faults
+    from hivemall_tpu.serving import artifact as serving_artifact
+
+    stream = _stream()
+    n_batches = 40
+    # ckpt every 4 batches: write 2 lands at batch 8, write 3 at 12 ...
+    plan = faults.FaultPlan(seed=3, faults=(
+        faults.Fault("crash_mid_write", at_write=3),
+        # write 5 lands at batch 16 post-restart; the transient fires
+        # BEFORE the next write rotates the rot away, so the resume MUST
+        # hit the corrupt newest and fall back to .prev
+        faults.Fault("corrupt", at_write=5),
+        faults.Fault("transient_step", at_step=17),
+    ))
+    reg = _registry()
+    root = tmp_path / "chaos"
+    p = ContinuousPipeline(reg, stream.block, _cfg(root))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with faults.inject(plan) as injector:
+            rep = p.run(n_batches)
+    assert {f["kind"] for f in injector.fired} == {
+        "crash_mid_write", "corrupt", "transient_step"}
+    assert rep["restarts"] == 2
+    assert set(rep["restart_causes"]) == {"CrashMidWrite",
+                                          "TransientStepError"}
+    # the rotted newest checkpoint was bypassed LOUDLY
+    assert any("falling back" in str(x.message) for x in w)
+    # every published artifact verifies end to end
+    for v in rep["published_versions"]:
+        serving_artifact.load(os.path.join(str(root), f"ctr-v{v}"),
+                              verify=True)
+    assert reg.get("ctr") is not None
+
+    # uninterrupted reference over the SAME stream: zero lost work
+    reg2 = _registry()
+    p2 = ContinuousPipeline(reg2, stream.block, _cfg(tmp_path / "base"))
+    p2.run(n_batches)
+    _, m_chaos = load_elastic(str(root / "ctr_pipeline_ckpt.npz"))
+    _, m_base = load_elastic(str(tmp_path / "base" / "ctr_pipeline_ckpt.npz"))
+    assert m_chaos["step"] == m_base["step"]
+    assert m_chaos["events"] == m_base["events"] == n_batches * 64
+    # replays happened (visible in stats) but the holdout ring was NOT
+    # double-fed: distinct holdout batches only (i % 8 == 1 in [0, 40))
+    assert rep["replayed_batches"] > 0
+    assert p.holdout.rows == p2.holdout.rows == 5 * 64
+
+
+def test_gate_never_publishes_a_rotted_artifact(tmp_path):
+    """The artifact_frozen chaos seam: a frozen candidate rotted between
+    freeze and gate fails sha256 verification and is refused — the
+    registry never sees it, and the NEXT cycle recovers."""
+    from hivemall_tpu.pipeline import ContinuousPipeline
+    from hivemall_tpu.pipeline import loop as pipeline_loop
+
+    rotted = []
+
+    def rot_first(path):
+        if not rotted:
+            ap = os.path.join(path, "arrays.npz")
+            size = os.path.getsize(ap)
+            with open(ap, "r+b") as f:
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0xFF]))
+            rotted.append(path)
+
+    reg = _registry()
+    p = ContinuousPipeline(reg, _stream().block, _cfg(tmp_path))
+    orig = pipeline_loop.artifact_frozen
+    pipeline_loop.artifact_frozen = rot_first
+    try:
+        rep = p.run(24)  # 3 cycles: v1 rotted, v2+ clean
+    finally:
+        pipeline_loop.artifact_frozen = orig
+    assert rotted
+    d0 = rep["decisions"][0]
+    assert not d0["published"] and d0["reason"] == "artifact_corrupt"
+    assert d0["version"] not in rep["published_versions"]
+    assert rep["publishes"] >= 1  # the loop recovered and published v2+
+    assert reg.get("ctr").version != d0["version"]
+
+
+def test_checkpoint_resume_continues_versions_and_republishes(tmp_path):
+    """A fresh process (new pipeline object, new registry) resuming the
+    same artifact_root republishes the last published version, continues
+    the version sequence, and consumes the stream exactly where the
+    checkpoint left it."""
+    from hivemall_tpu.io.checkpoint import load_elastic
+    from hivemall_tpu.pipeline import ContinuousPipeline
+
+    stream = _stream()
+    p1 = ContinuousPipeline(_registry(), stream.block, _cfg(tmp_path))
+    rep1 = p1.run(24)
+    assert rep1["publishes"] >= 1
+
+    reg2 = _registry()
+    p2 = ContinuousPipeline(reg2, stream.block, _cfg(tmp_path))
+    rep2 = p2.run(48)
+    # version sequence continues (no v1 restart), old tail preserved
+    assert rep2["published_versions"][:len(rep1["published_versions"])] \
+        == rep1["published_versions"]
+    assert len(rep2["published_versions"]) > len(rep1["published_versions"])
+    assert any(d["reason"] == "resume_republish"
+               for d in rep2["decisions"])
+    assert reg2.get("ctr").version == rep2["published_versions"][-1]
+    _, m = load_elastic(str(tmp_path / "ctr_pipeline_ckpt.npz"))
+    assert m["block_step"] == 48 and m["events"] == 48 * 64
+
+
+def test_crash_between_freeze_and_checkpoint_burns_the_version(tmp_path):
+    """A crash after freeze vN but before the next checkpoint leaves vN
+    frozen on disk while the checkpoint that resumes still says
+    next_version=N: the replayed cycle must burn the number (artifacts
+    are immutable) instead of dying on FileExistsError — the self-heal
+    contract covers the window that follows every publish."""
+    from hivemall_tpu.core.state import init_linear_state
+    from hivemall_tpu.models.base import TrainedLinearModel
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.pipeline import ContinuousPipeline
+    from hivemall_tpu.serving import artifact as serving_artifact
+
+    stream = _stream()
+    p1 = ContinuousPipeline(_registry(), stream.block, _cfg(tmp_path))
+    p1.run(4)  # checkpoints land, no freeze cycle yet (next_version=1)
+    # simulate the crash window: v1 froze, the process died before any
+    # checkpoint recorded it
+    model = TrainedLinearModel(
+        state=init_linear_state(DIMS, use_covariance=True), rule=AROW,
+        dims=DIMS, block_width=8)
+    serving_artifact.freeze(model, str(tmp_path / "ctr-v1"), name="ctr",
+                            version="1")
+
+    p2 = ContinuousPipeline(_registry(), stream.block, _cfg(tmp_path))
+    rep = p2.run(16)  # cycle at batch 8 wants version 1 — must burn it
+    assert rep["fatal"] is None and rep["publishes"] >= 1
+    assert rep["decisions"][0]["version"] == "2"
+    assert "1" not in [d["version"] for d in rep["decisions"]]
+    assert os.path.exists(str(tmp_path / "ctr-v1"))  # burned, not reused
+
+
+def test_trusted_holdout_stream_keeps_poison_out_of_the_gate(tmp_path):
+    """holdout_stream_fn: with clean_block as the delayed-ground-truth
+    source, the ring never holds flipped labels even when the flip window
+    covers holdout-routed batches."""
+    from hivemall_tpu.pipeline import ContinuousPipeline
+
+    stream = _stream(label_flip_events=(0, 10**9))  # flip EVERYTHING
+    p = ContinuousPipeline(_registry(), stream.block, _cfg(tmp_path),
+                           holdout_stream_fn=stream.clean_block)
+    p.run(10)  # batches 1 and 9 route to holdout
+    idx_rows, val_rows, labels = p.holdout.snapshot()
+    ci, cv, cl = stream.clean_block(1)
+    np.testing.assert_array_equal(labels[:64], cl)
+    np.testing.assert_array_equal(np.stack(idx_rows[:64]), ci)
+
+
+def test_rollback_invalidates_the_revert_snapshot(tmp_path):
+    """After a health-check rollback, revert-on-refuse must NOT restore
+    the trainer to the condemned version's state."""
+    from hivemall_tpu.core.state import init_linear_state
+    from hivemall_tpu.models.base import TrainedLinearModel
+    from hivemall_tpu.models.classifier import AROW
+    from hivemall_tpu.pipeline import ContinuousPipeline
+    from hivemall_tpu.serving import artifact as serving_artifact
+
+    reg = _registry()
+    stream = _stream()
+    p = ContinuousPipeline(reg, stream.block, _cfg(tmp_path))
+    rep = p.run(24)
+    assert p._publish_snapshot is not None
+    bad_state = init_linear_state(
+        DIMS, use_covariance=True,
+        initial_weights=-np.asarray(
+            np.random.RandomState(1).randn(DIMS), np.float32))
+    bad = TrainedLinearModel(state=bad_state, rule=AROW, dims=DIMS,
+                             block_width=8)
+    bad_path = os.path.join(str(tmp_path), "ctr-v998")
+    serving_artifact.freeze(bad, bad_path, name="ctr", version="998")
+    reg.deploy("ctr", serving_artifact.load(bad_path), version="998")
+    from hivemall_tpu.io.checkpoint import pack_linear_state
+
+    with p._lock:
+        p._published.append({"version": "998", "path": bad_path,
+                             "trained_through": rep["events"] - 1,
+                             "gate_logloss": None})
+    p._publish_snapshot = pack_linear_state(bad_state)
+    p._maybe_rollback(p.holdout.snapshot())
+    assert p.status()["rollbacks"] == 1
+    # the condemned state is no longer a revert target
+    assert p._publish_snapshot is None
+    # and the condemned version can never be a rollback TARGET either —
+    # [good, 998, rollback-to-good] must not ping-pong back to 998
+    assert "998" in p._condemned
+    p._maybe_rollback(p.holdout.snapshot())
+    assert p.status()["rollbacks"] == 1
+
+
+def test_pipelines_sharing_artifact_root_do_not_cross_resume(tmp_path):
+    """Checkpoints are name-scoped: a second pipeline with a different
+    name in the SAME artifact_root must cold-start its own version
+    sequence, not resume the first pipeline's weights and lineage."""
+    from hivemall_tpu.pipeline import ContinuousPipeline
+
+    stream = _stream()
+    pa = ContinuousPipeline(_registry(), stream.block,
+                            _cfg(tmp_path, name="ctr"))
+    rep_a = pa.run(16)
+    assert rep_a["publishes"] >= 1
+    pb = ContinuousPipeline(_registry(), stream.block,
+                            _cfg(tmp_path, name="other"))
+    rep_b = pb.run(16)
+    assert rep_b["decisions"][0]["reason"] == "first_publish"
+    assert rep_b["published_versions"][0] == "1"
+    assert os.path.exists(str(tmp_path / "ctr_pipeline_ckpt.npz"))
+    assert os.path.exists(str(tmp_path / "other_pipeline_ckpt.npz"))
+
+
+def test_quantized_publish_serves_at_reduced_precision(tmp_path):
+    from hivemall_tpu.pipeline import ContinuousPipeline
+
+    reg = _registry()
+    p = ContinuousPipeline(reg, _stream().block,
+                           _cfg(tmp_path, quantize="int8"))
+    rep = p.run(16)
+    assert rep["publishes"] >= 1
+    entry = reg.get("ctr")
+    assert entry.engine.weights_dtype == "int8"
+
+
+def test_amplify_trains_x_times_the_observed_rows(tmp_path):
+    from hivemall_tpu.pipeline import ContinuousPipeline
+
+    stream = _stream()
+    p1 = ContinuousPipeline(_registry(), stream.block,
+                            _cfg(tmp_path / "a", name="ctr", amplify_x=2))
+    rep = p1.run(8)
+    # batch 1 of 8 routes to holdout: 7 trained batches * 64 rows * 2
+    assert rep["trained_rows"] == 7 * 64 * 2
+    assert rep["events"] == 8 * 64
+    # deterministic: a second identical run trains identical weights
+    p2 = ContinuousPipeline(_registry(), stream.block,
+                            _cfg(tmp_path / "b", name="ctr", amplify_x=2))
+    p2.run(8)
+    from hivemall_tpu.io.checkpoint import load_elastic
+
+    a1, _ = load_elastic(str(tmp_path / "a" / "ctr_pipeline_ckpt.npz"))
+    a2, _ = load_elastic(str(tmp_path / "b" / "ctr_pipeline_ckpt.npz"))
+    np.testing.assert_array_equal(a1["weights"], a2["weights"])
+
+
+def test_start_stop_thread_lifecycle(tmp_path):
+    from hivemall_tpu.pipeline import ContinuousPipeline
+
+    reg = _registry()
+    p = ContinuousPipeline(reg, _stream().block, _cfg(tmp_path))
+    p.start(10**6)  # far more than we let it run
+    with pytest.raises(RuntimeError, match="already running"):
+        p.start(1)
+    # let it make some progress, then request a clean stop
+    deadline = 50
+    while p.status()["batches"] < 4 and deadline:
+        deadline -= 1
+        import time
+
+        time.sleep(0.1)
+    p.stop(timeout=60)
+    st = p.status()
+    assert not st["running"] and st["fatal"] is None
+    assert st["batches"] >= 4
+    # the final checkpoint landed at the stop point: a resume continues
+    from hivemall_tpu.io.checkpoint import load_elastic
+
+    _, m = load_elastic(str(tmp_path / "ctr_pipeline_ckpt.npz"))
+    assert m["block_step"] == st["batches"]
+    # a stale stop() (nothing running) must not leak into the next run
+    # and silently truncate it to zero batches
+    p.stop()
+    rep = p.run(m["block_step"] + 4)
+    assert rep["batches"] == m["block_step"] + 4 and rep["fatal"] is None

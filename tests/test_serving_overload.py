@@ -554,7 +554,15 @@ def test_traceparent_adopted_and_echoed(stack):
     assert e_sid != "00f067aa0ba902b7" and len(e_sid) == 16  # OUR root span
     from hivemall_tpu.runtime.tracing import TRACER
 
-    committed = [t for t in TRACER.traces() if t["trace_id"] == tid]
+    # the root span commits in the handler thread AFTER the response body
+    # is flushed — the client can observe the response before the trace
+    # lands in the ring; poll briefly instead of racing that window
+    committed = []
+    for _ in range(100):
+        committed = [t for t in TRACER.traces() if t["trace_id"] == tid]
+        if committed:
+            break
+        time.sleep(0.01)
     assert committed, "adopted trace never committed"
     root = [s for s in committed[-1]["spans"]
             if s["name"] == "server.predict"][0]
