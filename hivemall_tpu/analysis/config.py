@@ -147,7 +147,14 @@ DTYPEFLOW_HOT_MODULES = ("hivemall_tpu/serving/engine.py",
                          # delta accumulation, one cast at each table
                          # write; a full-table promotion here would hand
                          # back the bandwidth the compact plan bought
-                         "hivemall_tpu/core/batch_update.py")
+                         "hivemall_tpu/core/batch_update.py",
+                         # the native-apply staging layer (-native_apply):
+                         # host f32 tables + plan marshalling feeding the
+                         # ctypes ABI — a silent widening or float64
+                         # temporary here doubles the very traffic the
+                         # native pass exists to cut, and an unpinned
+                         # dtype would cross the ABI as garbage
+                         "hivemall_tpu/core/native_batch.py")
 HOT_MARKER = "# graftcheck: hot-module"
 
 # G018 scope: the serving/request path plus checkpoint IO — np.float64 (or a

@@ -79,6 +79,12 @@ def base_options() -> Options:
           "through one host-staged dedup plan (core/batch_update.py) — "
           "the CPU hot path; same mini-batch semantics as -mini_batch B "
           "(docs/execution_backends.md)", type=int)
+    o.add("native_apply", None, False,
+          "With -batch B: apply the staged dedup plans through one "
+          "vectorized C++ pass per block (core/native_batch.py) instead "
+          "of the XLA segment-sum step — same mini-batch semantics, "
+          "host-resident f32 tables; falls back LOUDLY to the XLA batch "
+          "path when the .so or the rule's native form is missing")
     return o
 
 
@@ -218,6 +224,54 @@ def _fit_native_scan(rule, hyper, cl, dims, idx_rows, val_rows, labels,
                               block_width=width)
 
 
+def _fit_native_batch(rule, hyper, cl, dims, idx_rows, val_rows, labels,
+                      width, block_size, batch_b, initial_weights,
+                      initial_covars) -> "TrainedLinearModel":
+    """`-batch B -native_apply`: the staged-plan batch backend executed by
+    one native C++ pass per block (core/native_batch.py). Plans are built
+    host-side exactly like the XLA batch path and REUSED across epochs
+    (cleared when -shuffle re-deals the rows); tables stay host-resident
+    f32 and collapse to a LinearState at the end."""
+    from ..core.batch_update import stage_block_plans
+    from ..core.native_batch import (init_native_tables,
+                                     make_native_batch_step,
+                                     native_tables_to_state)
+    from ..ops.convergence import ConversionState
+    from ..runtime.metrics import REGISTRY
+
+    step = make_native_batch_step(rule, hyper)
+    tables = init_native_tables(dims, rule.use_covariance,
+                                initial_weights, initial_covars)
+    iters = cl.get_int("iters", 1)
+    n = len(idx_rows)
+    conv = ConversionState(not cl.has("disable_cv"),
+                           cl.get_float("cv_rate", 0.005))
+    row_counter = REGISTRY.counter("hivemall", f"{rule.name}.examples")
+    iter_counter = REGISTRY.counter("hivemall", f"{rule.name}.iterations")
+    plan_cache: list = []
+    for it in range(max(1, iters)):
+        if cl.has("shuffle") and it > 0:
+            idx_rows, val_rows, labels = shuffle_rows(
+                idx_rows, val_rows, labels, cl.get_int("seed", 31) + it)
+            plan_cache = []
+        epoch_loss = 0.0
+        for bi, block in enumerate(iter_blocks(idx_rows, val_rows, labels,
+                                               dims, block_size, width)):
+            if bi >= len(plan_cache):
+                plan_cache.append(
+                    stage_block_plans(block.indices, batch_b, dims))
+            epoch_loss += step(tables, block.values, block.labels,
+                               plan_cache[bi])
+            row_counter.increment(block.batch_size)
+        iter_counter.increment()
+        conv.incr_loss(epoch_loss)
+        if iters > 1 and conv.is_converged(n):
+            break
+    state = native_tables_to_state(tables, rule, n * (it + 1))
+    return TrainedLinearModel(state=state, rule=rule, dims=dims,
+                              block_width=width)
+
+
 def fit_linear(
     rule: Rule,
     hyper: dict,
@@ -264,6 +318,13 @@ def fit_linear(
                              "-pallas/-mxu_scatter; pick one execution "
                              "backend (docs/execution_backends.md)")
         mode = "batch"
+    if cl.has("native_apply") and mode != "batch":
+        # -native_apply is a modifier of the batch backend, not a backend
+        # of its own — and it never composes with the other execution
+        # flags (the -mxu_scatter/-pallas/-native_scan combos land here
+        # or in the -batch refusal above)
+        raise ValueError("-native_apply rides the -batch backend; add "
+                         "-batch B (docs/execution_backends.md)")
     if mode == "minibatch":
         block_size = mini_batch
     if mode == "batch":
@@ -278,6 +339,25 @@ def fit_linear(
         return _fit_native_scan(rule, hyper, cl, dims, idx_rows, val_rows,
                                 labels, width, block_size,
                                 initial_weights, initial_covars)
+    if mode == "batch" and cl.has("native_apply"):
+        from ..core.native_batch import native_batch_unsupported_reason
+
+        f32_tables = not (dims > (1 << 24)
+                          and not cl.has("disable_halffloat"))
+        reason = native_batch_unsupported_reason(
+            rule, table_dtype_is_f32=f32_tables)
+        if reason is None:
+            return _fit_native_batch(rule, hyper, cl, dims, idx_rows,
+                                     val_rows, labels, width, block_size,
+                                     batch_b, initial_weights,
+                                     initial_covars)
+        # loud fallback, never silent: the XLA batch path has identical
+        # semantics, so training proceeds — but the operator asked for
+        # the native pass and must learn why they didn't get it
+        import warnings
+
+        warnings.warn(f"-native_apply unavailable ({reason}); falling "
+                      "back to the XLA batch backend", stacklevel=2)
     if mode == "batch":
         from ..core.batch_update import make_batch_train_step
 

@@ -126,6 +126,22 @@ def _bind_optional(lib: ctypes.CDLL) -> None:
     except AttributeError:  # older .so without the FM anchor loop
         pass
     try:
+        lib.hm_batch_apply_block.restype = ctypes.c_int64
+        lib.hm_batch_apply_block.argtypes = [
+            ctypes.c_int32, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_void_p,
+        ]
+    except AttributeError:  # older .so without the batched apply
+        pass
+    try:
         lib.hm_parse_features_batch.restype = ctypes.c_int64
         lib.hm_parse_features_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
@@ -137,6 +153,116 @@ def _bind_optional(lib: ctypes.CDLL) -> None:
 
 def available() -> bool:
     return _load() is not None
+
+
+def load_error() -> Optional[str]:
+    """The recorded load failure for a PRESENT-but-unloadable .so (toolchain
+    mismatch — the PR 11 GLIBCXX pathology), or None. Callers that refuse or
+    fall back on unavailability report this so the mismatch is named, never
+    swallowed (scripts/build_native.sh --if-stale rebuilds it away)."""
+    _load()
+    return _load_error
+
+
+def has_batch_apply() -> bool:
+    """True when the loaded .so exports the batched-apply entry point
+    (hm_batch_apply_block) — the -native_apply execution backend's probe."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "hm_batch_apply_block")
+
+
+# rule-family ids of hm_batch_apply_block's native closed forms — the ABI's
+# rule enum, mirrored (native/hivemall_native.cpp HM_BATCH_RULE_*)
+BATCH_APPLY_RULES = {"perceptron": 0, "cw": 1, "arow": 2, "arowh": 3}
+# hyperparameters each native form REQUIRES: a missing one must raise like
+# the XLA rule's hyper["..."] KeyError would, never default to a silently
+# degenerate 0.0 (phi=0 freezes CW entirely)
+_BATCH_APPLY_REQUIRED_HYPER = {"perceptron": (), "cw": ("phi",),
+                               "arow": ("r",), "arowh": ("r", "c")}
+
+
+def batch_apply_block(rule_name: str, hyper: dict, values: np.ndarray,
+                      labels: np.ndarray, main_plan, tail_plan, dims: int,
+                      weights: np.ndarray, covars: Optional[np.ndarray],
+                      touched: Optional[np.ndarray],
+                      mini_batch_average: bool = True) -> Optional[float]:
+    """Apply one staged block through hm_batch_apply_block: the whole
+    gather -> batch closed form -> segment-reduce -> scatter-back pass in
+    one native call, mutating the host-resident f32 tables in place.
+
+    `main_plan` is the block's stacked StagedDedupPlan ([nb, ...] leading
+    axis, core/batch_update.py::BlockPlans.main) or None; `tail_plan` the
+    remainder chunk's plan or None. Plans must satisfy the frozen ctypes
+    ABI (ops/scatter.py::plan_abi_arrays — int32, C-contiguous); values
+    [n_rows, width] f32, labels [n_rows] f32. Returns the block's loss sum,
+    or None when the library (or the symbol) is unavailable. Raises on a
+    rule outside BATCH_APPLY_RULES or malformed plan/table arguments."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hm_batch_apply_block"):
+        return None
+    if rule_name not in BATCH_APPLY_RULES:
+        raise ValueError(f"no native batch closed form for rule "
+                         f"{rule_name!r} (supported: "
+                         f"{sorted(BATCH_APPLY_RULES)})")
+    missing = [h for h in _BATCH_APPLY_REQUIRED_HYPER[rule_name]
+               if h not in hyper]
+    if missing:
+        raise KeyError(f"rule {rule_name!r} requires hyperparameter(s) "
+                       f"{missing} — same contract as the XLA rule's "
+                       f"hyper[...] access")
+    from ..ops.scatter import plan_abi_arrays
+
+    values = np.ascontiguousarray(values, np.float32)
+    labels = np.ascontiguousarray(labels, np.float32)
+    n_rows, width = values.shape
+    if labels.shape != (n_rows,):
+        raise ValueError(f"labels shape {labels.shape} != ({n_rows},) for "
+                         f"values {values.shape}")
+    as_p = lambda a: (a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
+                      if a is not None else None)
+    nb = bsz = slots_u = 0
+    mo = mls = mrep = mst = men = None
+    if main_plan is not None:
+        mo, mls, mrep, mst, men = plan_abi_arrays(main_plan, stacked=True)
+        nb, lanes = mo.shape
+        slots_u = mrep.shape[1]
+        bsz = lanes // width
+    tail_rows = tail_u = 0
+    to = tls = trep = tst = ten = None
+    if tail_plan is not None:
+        to, tls, trep, tst, ten = plan_abi_arrays(tail_plan)
+        tail_rows = to.shape[0] // width
+        tail_u = trep.shape[0]
+    for name, t, dt in (("weights", weights, np.float32),
+                        ("covars", covars, np.float32),
+                        ("touched", touched, np.int8)):
+        if t is None:
+            continue
+        if t.dtype != dt or not t.flags["C_CONTIGUOUS"]:
+            raise ValueError(f"native batch apply needs C-contiguous "
+                             f"{np.dtype(dt).name} {name} table, got "
+                             f"{t.dtype}")
+        if t.shape[0] < dims:
+            # the C pass writes any rp < dims: a short table would be
+            # heap corruption, not a drop — fail at the boundary
+            raise ValueError(f"{name} table has {t.shape[0]} rows < dims "
+                             f"{dims}")
+    loss = ctypes.c_double(0.0)
+    rc = lib.hm_batch_apply_block(
+        BATCH_APPLY_RULES[rule_name],
+        ctypes.c_float(float(hyper.get("r", 0.0))),
+        ctypes.c_float(float(hyper.get("c", 0.0))),
+        ctypes.c_float(float(hyper.get("phi", 0.0))),
+        as_p(values), as_p(labels), n_rows, width,
+        nb, bsz, slots_u, as_p(mo), as_p(mls), as_p(mrep), as_p(mst),
+        as_p(men), tail_rows, tail_u, as_p(to), as_p(tls), as_p(trep),
+        as_p(tst), as_p(ten), dims, as_p(weights), as_p(covars),
+        as_p(touched), 1 if mini_batch_average else 0,
+        ctypes.byref(loss))
+    if rc != 0:
+        raise ValueError("hm_batch_apply_block rejected its arguments "
+                         f"(rc={rc}): rule/plan/table mismatch")
+    return float(loss.value)
 
 
 def murmur3(data: bytes, seed: int = 0x9747B28C) -> Optional[int]:

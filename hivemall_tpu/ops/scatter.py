@@ -230,6 +230,61 @@ def build_staged_plan(idx_flat, dims: int, slots: int | None = None
                            ends=ends)
 
 
+# --------------------------------------------------------------------------
+# Plan ctypes ABI (FROZEN, v1) — the contract for plans crossing into
+# native/hivemall_native.cpp (hm_batch_apply_block, the -native_apply
+# backend):
+#
+#   field     dtype  shape            meaning
+#   order     int32  [N] / [nb, N]    permutation sorting the flat lane ids
+#   lane_seg  int32  [N] / [nb, N]    slot id of each ORIGINAL lane
+#   rep       int32  [U] / [nb, U]    ascending unique feature ids; pads
+#                                     carry distinct ids >= dims (dropped)
+#   starts    int32  [U] / [nb, U]    inclusive start in sorted lane order
+#   ends      int32  [U] / [nb, U]    exclusive end (== start on pads)
+#
+# All arrays C-contiguous host numpy; N = chunk_rows * width. The stacked
+# ([nb, ...]) form is BlockPlans.main — chunk c lives at flat offset c*N /
+# c*U, which is what C contiguity guarantees. Changing any dtype, field
+# order, pad convention, or the ascending-rep promise is an ABI break:
+# bump PLAN_ABI_VERSION and the .so together (scripts/build_native.sh
+# --if-stale re-probes the symbol so a stale library can't run silently).
+# --------------------------------------------------------------------------
+
+PLAN_ABI_VERSION = 1
+
+
+def plan_abi_arrays(plan: StagedDedupPlan, stacked: bool = False):
+    """Validate `plan` against the frozen ctypes ABI above and return its
+    arrays as host numpy in field order. Raises TypeError/ValueError on any
+    dtype, contiguity, or rank violation — a plan that came back from
+    device (jnp) or was built with the wrong dtype must fail HERE, not
+    corrupt memory inside the native call."""
+    import numpy as np
+
+    ndim = 2 if stacked else 1
+    out = []
+    for f in StagedDedupPlan._fields:
+        a = getattr(plan, f)
+        if not isinstance(a, np.ndarray):
+            raise TypeError(
+                f"plan.{f} is {type(a).__name__}, not host numpy — the "
+                "native ABI takes staging-time plans (device plans have "
+                "no stable buffer address)")
+        if a.dtype != np.int32:
+            raise TypeError(f"plan.{f} dtype {a.dtype} != int32 (ABI v"
+                            f"{PLAN_ABI_VERSION})")
+        if a.ndim != ndim:
+            raise ValueError(f"plan.{f} rank {a.ndim} != {ndim} "
+                             f"({'stacked' if stacked else 'single-chunk'} "
+                             "form)")
+        if not a.flags["C_CONTIGUOUS"]:
+            raise ValueError(f"plan.{f} is not C-contiguous (ABI v"
+                             f"{PLAN_ABI_VERSION})")
+        out.append(a)
+    return tuple(out)
+
+
 def pad_plan(plan: StagedDedupPlan, slots: int, dims: int
              ) -> StagedDedupPlan:
     """Widen a host-built plan to a larger U bucket (chunks scanned

@@ -1,10 +1,52 @@
 #!/usr/bin/env bash
 # Build the native host-ops shared library (native/hivemall_native.cpp) into
 # hivemall_tpu/native/libhivemall_native.so. Pure C ABI, consumed via ctypes.
+#
+# --if-stale: rebuild only when the .so is missing, older than its source,
+# unloadable on THIS host (the PR 11 GLIBCXX-mismatch pathology: a .so built
+# elsewhere fails CDLL and everything silently fell back to Python), or
+# predates the newest required symbol. Exits 0 WITHOUT building when no C++
+# compiler is present — hivemall_tpu.native then reports unavailability
+# loudly (warnings + load_error()) and the native bench gates skip with the
+# reason in-artifact. A present compiler that fails to build is a hard
+# error: scripts/test.sh runs this un-guarded so a broken toolchain fails
+# tier-1 instead of shipping a stale library.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+SO=hivemall_tpu/native/libhivemall_native.so
+SRC=native/hivemall_native.cpp
+# bumped with the plan ABI (ops/scatter.py PLAN_ABI_VERSION): a loadable
+# .so missing this symbol predates the current ABI and must be rebuilt
+PROBE_SYMBOL=hm_batch_apply_block
+
+if [[ "${1:-}" == "--if-stale" ]]; then
+  fresh=0
+  if [[ -f "$SO" && "$SO" -nt "$SRC" ]]; then
+    if python - "$SO" "$PROBE_SYMBOL" <<'EOF'
+import ctypes, sys
+try:
+    lib = ctypes.CDLL(sys.argv[1])
+except OSError:
+    sys.exit(1)  # present but unloadable on this host: stale
+sys.exit(0 if hasattr(lib, sys.argv[2]) else 1)
+EOF
+    then fresh=1; fi
+  fi
+  if [[ "$fresh" == 1 ]]; then
+    echo "native: $SO is fresh (loads, exports $PROBE_SYMBOL)"
+    exit 0
+  fi
+  if ! command -v g++ >/dev/null 2>&1; then
+    echo "native: $SO is stale/missing and no g++ is available;" \
+         "skipping build — hivemall_tpu.native will report the" \
+         "load failure loudly and native gates skip with the reason" >&2
+    exit 0
+  fi
+fi
+
 mkdir -p hivemall_tpu/native
 g++ -O3 -march=native -fPIC -shared -std=c++17 \
     native/hivemall_native.cpp \
-    -o hivemall_tpu/native/libhivemall_native.so
-echo "built hivemall_tpu/native/libhivemall_native.so"
+    -o "$SO"
+echo "built $SO"

@@ -58,6 +58,28 @@ def report(name, fn, *args, n=20):
           f"first call {compile_ms:8.1f} ms ({misses} compile)")
 
 
+def timeit_host(name, fn, *args, n=20):
+    """Host-native timing: ONE `host_native` bucket, no compile/steady
+    split — a ctypes call has no jit cache to miss and no dispatch stream
+    to drain, so folding it into 'steady' would misattribute host CPU
+    time as device step time in traces. The span is `host_native` so the
+    Perfetto breakdown keeps the bucket distinct."""
+    fn(*args)  # warm allocations (table/scratch), outside the window
+    with TRACER.span(f"profile.{name}"):
+        with TRACER.span("host_native", args={"iters": n}):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                fn(*args)
+            host_ms = (time.perf_counter() - t0) / n * 1e3
+    return host_ms
+
+
+def report_host(name, fn, *args, n=20):
+    host_ms = timeit_host(name, fn, *args, n=n)
+    print(f"{name:<17}: {host_ms:8.3f} ms/step host-native | "
+          "(no jit: own bucket, not 'steady')")
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--trace-out", default=None,
@@ -132,6 +154,29 @@ def main():
 
     lane = jnp.ones_like(idx, jnp.int8)
     report("touched max int8", touch_max, touched, idx, lane)
+
+    # the -native_apply backend's whole per-block apply (gather -> batch
+    # closed form -> segment reduce -> scatter-back in one C pass) as its
+    # own host-native bucket — attributable next to the jitted kernels
+    # instead of disappearing into a 'steady' number it doesn't belong to
+    from hivemall_tpu.core.native_batch import (
+        init_native_tables, make_native_batch_step,
+        native_batch_unsupported_reason)
+    from hivemall_tpu.models.classifier import AROW
+
+    reason = native_batch_unsupported_reason(AROW)
+    if reason is None:
+        from hivemall_tpu.core.batch_update import stage_block_plans
+
+        idx_h = np.asarray(idx)
+        val_h = np.ones((batch, width), np.float32)
+        lab_h = np.sign(rng.randn(batch)).astype(np.float32)
+        plans = stage_block_plans(idx_h, 2048, dims)
+        tables = init_native_tables(dims, use_covariance=True)
+        step = make_native_batch_step(AROW, {"r": 0.1})
+        report_host("native apply", step, tables, val_h, lab_h, plans)
+    else:
+        print(f"native apply      : skipped ({reason})")
 
     if args.trace_out:
         doc = TRACER.export_chrome(args.trace_out)

@@ -7,6 +7,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# native library freshness: rebuild libhivemall_native.so when the C++
+# source is newer, the .so cannot load on THIS host (the PR 11
+# GLIBCXX-mismatch silent-fallback pathology), or it predates the current
+# plan ABI — skipped cleanly when no compiler exists (native.available()
+# then reports the mismatch loudly and the native gates skip with the
+# reason in-artifact). A present-but-broken toolchain fails here, before
+# any gate runs against a stale library.
+bash scripts/build_native.sh --if-stale
+
 # tier-1 gate 1: graftcheck static analysis on changed files (+ their
 # callers) — any new non-baselined recompile/host-sync/dtype/axis/donation/
 # side-effect/SPMD-safety/precision-flow finding fails before pytest spends
@@ -75,8 +84,13 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # tier-1 gate 8: batched-backend smoke — the segment-sum batch path
 # (-batch B, core/batch_update.py) must beat the row-serial JAX scan on
 # this host by >= 1.5x AND hold the holdout-logloss parity tolerance at
-# the smoke batch size (docs/execution_backends.md; prints one
-# BENCH-style JSON line)
+# the smoke batch size; the native half additionally requires the
+# -native_apply backend (core/native_batch.py) to beat the XLA batch
+# path >= 1.2x AND the measured C row loop >= 1.0x at the standard
+# 2^22-dim regime with its own logloss parity pin — skipped loudly
+# (reason in the JSON) only when no .so exists and no compiler can
+# build one (docs/execution_backends.md; prints one BENCH-style JSON
+# line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python bench.py --batch-smoke
 

@@ -407,7 +407,8 @@ def test_ops_and_serving_are_dtype_clean():
              os.path.join(PKG, "kernels"),
              os.path.join(PKG, "serving"),
              os.path.join(PKG, "io"),
-             os.path.join(PKG, "core", "batch_update.py")]
+             os.path.join(PKG, "core", "batch_update.py"),
+             os.path.join(PKG, "core", "native_batch.py")]
     dtype_rules = ("G017", "G018", "G019", "G020", "G021")
     hits = [f for f in analyze_paths(paths) if f.rule in dtype_rules]
     assert hits == [], "\n".join(f.format() for f in hits)
@@ -425,6 +426,10 @@ def test_batch_update_module_is_always_hot():
     from hivemall_tpu.analysis import config
 
     assert "hivemall_tpu/core/batch_update.py" in \
+        config.DTYPEFLOW_HOT_MODULES
+    # PR 14: the native-apply staging layer joined the same scope — an
+    # unpinned dtype there crosses the ctypes ABI as garbage
+    assert "hivemall_tpu/core/native_batch.py" in \
         config.DTYPEFLOW_HOT_MODULES
     src = (
         "import jax.numpy as jnp\n\n\n"
