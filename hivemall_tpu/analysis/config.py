@@ -137,6 +137,16 @@ DTYPEFLOW_HOT_PREFIXES = (
 # promotion in the quant plumbing fails tier-1 (scripts/lint.sh) before a
 # benchmark ever runs.
 DTYPEFLOW_HOT_MODULES = ("hivemall_tpu/serving/engine.py",
+                         # the hot-row score cache (the serving L0 fast
+                         # path): cached values ARE the engine's computed
+                         # predictions — a silent widening or f64 leak in
+                         # the cache plumbing would break the cached ==
+                         # computed bit-parity gate the skew bench pins.
+                         # (G012-G016 concurrency scope is the serving/
+                         # prefix — CONCURRENCY_HOT_PREFIXES above — so
+                         # cache.py's lock discipline is gated the same
+                         # way as batcher.py's.)
+                         "hivemall_tpu/serving/cache.py",
                          # the sharded score path: per-window widens only
                          # (G019) and f32 accumulation (G021), same
                          # contract as the single-device _q8_* scorers

@@ -14,6 +14,7 @@ from .admission import (AIMDController, DeadlineExpired, PRIORITY_NAMES,
                         QueueFull, ShedLowPriority, priority_class)
 from .artifact import Artifact, family_of, freeze, load
 from .batcher import BatcherClosed, DynamicBatcher
+from .cache import ScoreCache
 from .engine import Servable, ServingEngine, make_servable
 from .placement import (ModelExceedsDeviceBudget, ModelSharded, Placement,
                         Replicated, SingleDevice)
@@ -21,7 +22,7 @@ from .server import ModelEntry, ModelRegistry, serve
 
 __all__ = [
     "Artifact", "family_of", "freeze", "load",
-    "DynamicBatcher", "QueueFull", "BatcherClosed",
+    "DynamicBatcher", "QueueFull", "BatcherClosed", "ScoreCache",
     "AIMDController", "DeadlineExpired", "ShedLowPriority",
     "PRIORITY_NAMES", "priority_class",
     "Servable", "ServingEngine", "make_servable",

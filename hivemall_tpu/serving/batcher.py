@@ -35,7 +35,17 @@ collapsing (serving/admission.py holds the primitives):
 - a batch closes when it holds the controller's current batch-row target
   OR the anchor request's window elapses OR a member's deadline arrives;
 - every request gets a `concurrent.futures.Future`; a worker failure
-  fails the affected requests, never the process.
+  fails the affected requests, never the process;
+- **hot-row cache + coalescing** (optional — ``cache=`` a
+  serving/cache.py ScoreCache): consulted BEFORE the admission lock, so
+  a request whose rows are all cached (version-exact keys) resolves
+  without consuming queue capacity, class quota, or a batch slot, and a
+  request fully covered by cache + in-flight leaders shares those
+  leaders' computation. Anything else flows unchanged. Note one
+  deliberate asymmetry: a CLOSED (draining) batcher still serves cache
+  hits — the entry was resolved before the swap, and its answer is
+  labeled with the version it was admitted under, exactly like a request
+  that beat the swap by a millisecond.
 
 The admission decision is ONE lock acquisition: quota check, shed
 selection, queue append and every counter update happen under ``_cv`` with
@@ -119,8 +129,22 @@ class DynamicBatcher:
                  max_delay_ms_cap: Optional[float] = None,
                  priority_quota_fracs: Optional[Sequence[float]] = None,
                  starvation_limit: int = 8,
-                 express_high: bool = False) -> None:
+                 express_high: bool = False,
+                 cache=None, cache_version: str = "",
+                 row_key_fn=None) -> None:
         self.predict_fn = predict_fn
+        # the hot-row score cache front (serving/cache.py): consulted in
+        # submit() BEFORE the admission lock, so a fully-cached or fully-
+        # coalesced request resolves without consuming queue capacity,
+        # class quota, or a batch slot. The cache object is shared across
+        # this model's versions (ModelRegistry owns it); cache_version is
+        # THIS batcher's version — captured at admission into every key,
+        # which is the whole hot-swap invalidation story. row_key_fn is
+        # the engine's canonical per-row key derivation (None per request
+        # = not cacheable, flows unchanged).
+        self._cache = cache
+        self._cache_version = str(cache_version)
+        self._row_key_fn = row_key_fn if cache is not None else None
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
         self.max_queue_rows = int(max_queue_rows)
@@ -212,7 +236,13 @@ class DynamicBatcher:
         Over-quota admission raises `QueueFull` (reason "quota"); an
         accepted request later evicted for higher-priority work fails
         with `ShedLowPriority` (reason "shed"). Both carry
-        ``retry_after_s`` from the live drain-rate estimate."""
+        ``retry_after_s`` from the live drain-rate estimate.
+
+        With a cache attached, a fully-covered request resolves without
+        queueing; a COALESCED request inherits its leader's fate wholesale
+        (queue position, effective deadline, failure mode — see
+        serving/cache.py), its own ``priority``/``deadline_ms`` validated
+        but not separately enforced."""
         cls = priority_class(priority)
         if deadline_ms is not None:
             deadline_ms = float(deadline_ms)
@@ -237,48 +267,86 @@ class DynamicBatcher:
             owns = span.recording
         p = _Pending(list(instances), span, owns, cls, deadline_ms)
         k = len(p.instances)
+        # the hot-row cache front, BEFORE the admission lock: a fully
+        # cached request resolves right here (no queue capacity, no class
+        # quota, no batch slot) and a request fully covered by cache +
+        # in-flight leaders attaches to those leaders' Futures
+        # (serving/cache.py). Any uncovered row -> the request flows
+        # unchanged below, leading its new keys; its Future's outcome
+        # settles the cache (populate on success, fail followers with the
+        # same reason on shed/expiry/engine error).
+        token = None
+        if self._cache is not None and self._row_key_fn is not None:
+            keys = self._row_key_fn(p.instances)
+            if keys is not None:
+                plan = self._cache.admit(self._cache_version, keys,
+                                         p.future)
+                if plan.kind == "hit":
+                    if span.recording:
+                        span.event("cache.hit", rows=plan.hit_rows,
+                                   version=self._cache_version)
+                    if owns:
+                        p.future.add_done_callback(
+                            lambda f, s=span: TRACER.end(s))
+                    # outside every lock: set_result runs done-callbacks
+                    # synchronously (G013)
+                    p.future.set_result(plan.values)
+                    return p.future
+                if plan.kind == "coalesced":
+                    if span.recording:
+                        span.event("cache.coalesced",
+                                   rows=plan.coalesced_rows,
+                                   hit_rows=plan.hit_rows,
+                                   version=self._cache_version)
+                    if owns:
+                        p.future.add_done_callback(
+                            lambda f, s=span: TRACER.end(s))
+                    return p.future  # the cache settles it with the leaders
+                token = plan.token
         evicted: List[_Pending] = []
-        err: Optional[QueueFull] = None
+        err: Optional[Exception] = None
+        ra = None
         # the whole admission decision is ONE lock acquisition: quota
         # check, shed selection, append and counters — no check-then-act
         # window for a concurrent submit to slip through
         with self._cv:
             if self._closed:
-                raise BatcherClosed(f"batcher {self.name!r} is closed")
-            quota = self._quota_rows[cls]
-            ra = None
-            if self._depth_rows + k > quota:
-                ra = self._retry_after_locked()
-                # make room by dropping the newest strictly-lower-priority
-                # queued work (oldest keep their place in line) — but only
-                # when the lower classes actually hold enough rows to
-                # admit this request: shedding someone and STILL rejecting
-                # would destroy accepted work for nothing
-                need = self._depth_rows + k - quota
-                if sum(self._class_rows[c]
-                       for c in range(cls + 1, len(self._qs))) >= need:
-                    self._shed_lower_locked(cls, need, evicted)
-            if self._depth_rows + k > quota:
-                self._quota_rejected_c[cls].increment()
-                self._rejected.increment()
-                err = QueueFull(
-                    f"batcher {self.name!r}: {PRIORITY_NAMES[cls]}-priority "
-                    f"admission quota is {quota} rows, queue holds "
-                    f"{self._depth_rows} — shed load",
-                    reason="quota", retry_after_s=ra)
+                err = BatcherClosed(f"batcher {self.name!r} is closed")
             else:
-                self._qs[cls].append(p)
-                self._class_rows[cls] += k
-                self._depth_rows += k
-                self._accepted.increment()
-                self._accepted_c[cls].increment()
-                self._set_depth_gauges_locked()
-                if self.express_high:
-                    # two workers wait on one CV; notify() could wake the
-                    # lane that cannot serve this class
-                    self._cv.notify_all()
+                quota = self._quota_rows[cls]
+                if self._depth_rows + k > quota:
+                    ra = self._retry_after_locked()
+                    # make room by dropping the newest strictly-lower-
+                    # priority queued work (oldest keep their place in
+                    # line) — but only when the lower classes actually
+                    # hold enough rows to admit this request: shedding
+                    # someone and STILL rejecting would destroy accepted
+                    # work for nothing
+                    need = self._depth_rows + k - quota
+                    if sum(self._class_rows[c]
+                           for c in range(cls + 1, len(self._qs))) >= need:
+                        self._shed_lower_locked(cls, need, evicted)
+                if self._depth_rows + k > quota:
+                    self._quota_rejected_c[cls].increment()
+                    self._rejected.increment()
+                    err = QueueFull(
+                        f"batcher {self.name!r}: {PRIORITY_NAMES[cls]}"
+                        f"-priority admission quota is {quota} rows, queue "
+                        f"holds {self._depth_rows} — shed load",
+                        reason="quota", retry_after_s=ra)
                 else:
-                    self._cv.notify()
+                    self._qs[cls].append(p)
+                    self._class_rows[cls] += k
+                    self._depth_rows += k
+                    self._accepted.increment()
+                    self._accepted_c[cls].increment()
+                    self._set_depth_gauges_locked()
+                    if self.express_high:
+                        # two workers wait on one CV; notify() could wake
+                        # the lane that cannot serve this class
+                        self._cv.notify_all()
+                    else:
+                        self._cv.notify()
         # outside the lock: set_exception runs done-callbacks synchronously,
         # and arbitrary callback code must never execute while _cv is held
         # (the G013 blocking-under-lock hazard)
@@ -289,7 +357,21 @@ class DynamicBatcher:
                     f"priority request shed for higher-priority work",
                     retry_after_s=ra))
         if err is not None:
+            # a refused leader registered nothing (leadership is taken by
+            # lead() below, only on success), so no follower can be
+            # stranded on an admission error — the refusal stays
+            # synchronous, where registry.submit's swap-retry can see it
             raise err
+        if token is not None:
+            # NOW the request is queued: take leadership of its new keys,
+            # then let its outcome settle the cache — success populates
+            # and resolves followers; shed / expiry / engine error /
+            # drop-on-close fails them with the same reason. settle runs
+            # as a done-callback — outside _cv by the G013 discipline
+            # every set_result/set_exception site already follows.
+            self._cache.lead(token)
+            p.future.add_done_callback(
+                lambda f, t=token: self._cache.settle(t, f))
         if owns:
             p.future.add_done_callback(lambda f, s=span: TRACER.end(s))
         return p.future

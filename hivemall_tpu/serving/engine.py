@@ -200,6 +200,16 @@ class _Servable:
     def dispatch(self, staged):
         raise NotImplementedError
 
+    def row_keys(self, instances, width_cap: int):
+        """Per-row canonical cache keys for the hot-row score cache
+        (serving/cache.py), or None when this request — or this family —
+        is not cacheable. The key hashes the canonical PRE-PARSED row
+        form (what staging actually scores: ids mod dims, f32 values), so
+        a string row and its pre-parsed twin share one cache line. The
+        default is None: families whose request form has no cheap
+        canonical key (trees, FFM) simply bypass the cache."""
+        return None
+
     def run_padded(self, instances, b_pad: int, width_cap: int):
         return self.dispatch(self.stage(instances, b_pad, width_cap))
 
@@ -338,6 +348,50 @@ class _SparseRowServable(_Servable):
     def dummy_instance(self, width):
         return [(i, 1.0) for i in range(width)]
 
+    def row_keys(self, instances, width_cap: int):
+        """blake2b-128 digests over (ids mod dims as int64, values as
+        f32), in row order. Rows wider than ``width_cap`` make the WHOLE
+        request uncacheable (None): truncation semantics live in staging,
+        and replicating them here would be a second source of truth. Row
+        order is part of the key — a permuted duplicate is a different
+        fp-reduction order, so it conservatively gets its own entry."""
+        from hashlib import blake2b
+
+        if _is_preparsed(instances):
+            if len(instances) == 3:
+                flat_i, flat_v, lens = instances
+                lens = np.asarray(lens, np.int64)
+                if lens.size and int(lens.max()) > width_cap:
+                    return None
+                flat_i = np.asarray(flat_i, np.int64) % self.dims
+                flat_v = np.asarray(flat_v, np.float32)
+                off = np.zeros(len(lens) + 1, np.int64)
+                np.cumsum(lens, out=off[1:])
+                idx_rows = [flat_i[off[i]:off[i + 1]]
+                            for i in range(len(lens))]
+                val_rows = [flat_v[off[i]:off[i + 1]]
+                            for i in range(len(lens))]
+            else:
+                idx_rows = [np.asarray(r, np.int64) % self.dims
+                            for r in instances[0]]
+                val_rows = [np.asarray(v, np.float32) for v in instances[1]]
+        else:
+            from ..models.base import _stage_rows
+
+            try:
+                idx_rows, val_rows = _stage_rows(instances, self.dims)
+            except Exception:  # malformed rows fail in staging, as today
+                return None
+        keys = []
+        for idx, val in zip(idx_rows, val_rows):
+            if len(idx) > width_cap:
+                return None
+            keys.append(blake2b(
+                np.ascontiguousarray(idx, np.int64).tobytes()
+                + np.ascontiguousarray(val, np.float32).tobytes(),
+                digest_size=16).digest())
+        return keys
+
 
 class _LinearServable(_SparseRowServable):
     family = "linear"
@@ -473,6 +527,17 @@ class _PairServable(_Servable):
 
     def dummy_instance(self, width):
         return (0, 0)
+
+    def row_keys(self, instances, width_cap: int):
+        """A (user, item) pair IS its own canonical 16-byte key — no
+        digest needed (same length as the sparse families' blake2b-128,
+        so cache cost accounting is uniform)."""
+        try:
+            pairs = np.ascontiguousarray(
+                np.asarray(instances, np.int64).reshape(len(instances), 2))
+        except (TypeError, ValueError):
+            return None
+        return [p.tobytes() for p in pairs]
 
 
 class _MFServable(_PairServable):
@@ -1072,6 +1137,18 @@ class ServingEngine:
         REGISTRY.set_gauge(f"serving.{self.name}.warmup_compiles",
                            float(g.compiles))
         return g.compiles
+
+    def row_keys(self, instances):
+        """Per-row canonical cache keys for this request, or None when it
+        is not cacheable (unsupported family, over-wide rows, malformed
+        input — which then fails through the normal predict path). The
+        hot-row score cache keys ``(model_version, row_key)`` on these
+        (serving/cache.py; docs/serving.md "Score caching &
+        coalescing")."""
+        try:
+            return self.servable.row_keys(instances, self.max_width)
+        except Exception:
+            return None
 
     def predict(self, instances: Sequence):
         """Score a request of any size (chunks above max_batch). Each

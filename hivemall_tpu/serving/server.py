@@ -58,11 +58,15 @@ class ModelEntry:
 
     def __init__(self, name: str, version: str, engine: ServingEngine,
                  batcher: DynamicBatcher,
-                 lineage: Optional[list] = None) -> None:
+                 lineage: Optional[list] = None, cache=None) -> None:
         self.name = name
         self.version = version
         self.engine = engine
         self.batcher = batcher
+        # the hot-row score cache this entry's batcher fronts with —
+        # owned by the REGISTRY and shared across this name's versions
+        # (the version lives in the key; serving/cache.py). None = off.
+        self.cache = cache
         self.deployed_unix = time.time()
         # version lineage: the publisher's recent gate decisions (publish /
         # refusal / rollback records — hivemall_tpu/pipeline) surfaced on
@@ -93,6 +97,11 @@ class ModelEntry:
             # quota fractions, live AIMD controller window, drain-rate
             # estimate and shed/expiry/quota-reject counters
             "admission": self.batcher.overload_state(),
+            # the hot-row cache surface: budget, resident bytes, hit/miss/
+            # coalesced/evicted counters and the live hit ratio
+            # (docs/serving.md "Score caching & coalescing")
+            "cache": self.cache.stats() if self.cache is not None
+            else {"enabled": False},
             # publisher lineage: recent gate decisions for this model's
             # version sequence (empty for hand-deployed models)
             "lineage": [dict(d) for d in self.lineage],
@@ -123,8 +132,18 @@ class ModelRegistry:
                  priority_quota_fracs: Optional[tuple] = None,
                  starvation_limit: int = 8,
                  express_high: bool = True,
-                 degraded_depth_fraction: float = 0.75) -> None:
+                 degraded_depth_fraction: float = 0.75,
+                 score_cache_bytes: Optional[int] = None) -> None:
         self._entries: Dict[str, ModelEntry] = {}
+        # hot-row score caches, one per model NAME, shared across that
+        # name's versions (the version is in every key, so a hot-swap
+        # invalidates atomically and old-version entries age out of the
+        # byte budget — serving/cache.py). ``score_cache_bytes`` is the
+        # registry-wide default budget; None/0 leaves caching OFF (the
+        # conservative default: admission counters then mean exactly what
+        # PR 10 pinned), a deploy can override per model.
+        self._caches: Dict[str, object] = {}
+        self.score_cache_bytes = score_cache_bytes
         self._lock = threading.Lock()
         self.max_batch = max_batch
         self.max_delay_ms = max_delay_ms
@@ -149,6 +168,7 @@ class ModelRegistry:
     def deploy(self, name: str, source, version: Optional[str] = None,
                batcher_overrides: Optional[dict] = None,
                lineage: Optional[list] = None,
+               score_cache_bytes: Optional[int] = None,
                **engine_overrides) -> ModelEntry:
         """Deploy `source` (artifact dir path, Artifact, or trained model)
         as `name`; replaces any current version atomically AFTER the new
@@ -162,7 +182,13 @@ class ModelRegistry:
         queue, so one model's flood can never 503 another. ``lineage``
         attaches the publisher's gate-decision records to the entry
         (surfaced on /models — the continuous-training pipeline passes its
-        recent publish/refusal/rollback history here)."""
+        recent publish/refusal/rollback history here).
+        ``score_cache_bytes`` overrides the registry's hot-row cache
+        budget for this model (None inherits the registry default — or,
+        failing that, whatever cache an earlier deploy enabled for this
+        name; an explicit 0 disables); the cache OBJECT persists across
+        this name's versions — swap invalidation is the version key, not
+        a flush (docs/serving.md "Score caching & coalescing")."""
         from .artifact import Artifact, load as load_artifact
 
         if isinstance(source, str):
@@ -189,9 +215,32 @@ class ModelRegistry:
                    starvation_limit=self.starvation_limit,
                    express_high=self.express_high)
         bkw.update(batcher_overrides or {})
-        batcher = DynamicBatcher(engine.predict, name=name, **bkw)
+        cache_bytes = self.score_cache_bytes if score_cache_bytes is None \
+            else score_cache_bytes
+        cache = None
+        if cache_bytes:
+            from .cache import ScoreCache
+
+            with self._lock:
+                cache = self._caches.get(name)
+                if cache is None or cache.max_bytes != int(cache_bytes):
+                    cache = ScoreCache(int(cache_bytes), name=name)
+                    self._caches[name] = cache
+        elif score_cache_bytes is not None:
+            with self._lock:  # explicit 0: caching OFF for this name
+                self._caches.pop(name, None)
+        else:
+            # no override and no registry default: a cache an earlier
+            # deploy enabled for this name SURVIVES the redeploy — the
+            # object persisting across versions is the hot-swap story
+            # (old-version entries age out of the byte budget)
+            with self._lock:
+                cache = self._caches.get(name)
+        batcher = DynamicBatcher(engine.predict, name=name, cache=cache,
+                                 cache_version=str(version),
+                                 row_key_fn=engine.row_keys, **bkw)
         entry = ModelEntry(name, str(version), engine, batcher,
-                           lineage=lineage)
+                           lineage=lineage, cache=cache)
         with self._lock:
             old = self._entries.get(name)
             self._entries[name] = entry  # the atomic publish
@@ -284,6 +333,7 @@ class ModelRegistry:
     def undeploy(self, name: str) -> bool:
         with self._lock:
             entry = self._entries.pop(name, None)
+            self._caches.pop(name, None)
         if entry is None:
             return False
         entry.batcher.close(drain=True)
@@ -298,6 +348,7 @@ class ModelRegistry:
         with self._lock:
             entries = list(self._entries.values())
             self._entries = {}
+            self._caches = {}
         for e in entries:
             e.batcher.close(drain=True)
 

@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import threading
 from collections import OrderedDict
-from typing import Any, Dict, Generic, Iterable, Iterator, List, Optional, TypeVar
+from typing import Any, Callable, Dict, Generic, Iterable, Iterator, List, \
+    Optional, Tuple, TypeVar
 
 import numpy as np
 
@@ -51,27 +53,145 @@ class BoundedPriorityQueue(Generic[T]):
 
 
 class LRUMap(OrderedDict):
-    """Fixed-capacity LRU (ref: utils/collections/LRUMap.java)."""
+    """Fixed-capacity LRU (ref: utils/collections/LRUMap.java).
 
-    def __init__(self, capacity: int):
+    ``on_evict(key, value)`` is the cost-aware eviction hook: it fires for
+    every entry the map drops to stay within ``capacity`` (and from
+    explicit ``evict_oldest()`` calls), AFTER the entry is removed — a
+    byte-budgeted wrapper (serving/cache.py) keeps its resident-cost
+    accounting exact by decrementing in the hook, so capacity eviction and
+    budget eviction share one accounting path. ``capacity <= 0`` is the
+    degenerate "holds nothing" map: every insert is immediately evicted
+    through the hook (a cache configured with a zero budget stays
+    consistent instead of raising from an empty-iterator pop).
+
+    NOT thread-safe: reads rotate the recency list, so even ``m[k]`` is a
+    write (``dict.get`` stays a C-level peek and does NOT rotate — the
+    documented escape hatch for lock-free inspection). Share across
+    threads via `SynchronizedLRUMap`, or hold your own lock when map ops
+    must be atomic with surrounding accounting (what serving/cache.py
+    does).
+    """
+
+    def __init__(self, capacity: int,
+                 on_evict: Optional[Callable[[Any, Any], None]] = None):
         super().__init__()
         self.capacity = capacity
+        self.on_evict = on_evict
+
+    def evict_oldest(self) -> Optional[Tuple[Any, Any]]:
+        """Drop the least-recently-used entry, firing ``on_evict``;
+        returns the ``(key, value)`` pair or None when empty. The value
+        read bypasses the overridden ``__getitem__`` so eviction never
+        rotates recency (and never trips the popitem re-entrancy below)."""
+        if not self:
+            return None
+        oldest = next(iter(self))
+        value = OrderedDict.__getitem__(self, oldest)
+        super().__delitem__(oldest)
+        if self.on_evict is not None:
+            self.on_evict(oldest, value)
+        return oldest, value
 
     def __setitem__(self, key, value):
         if key in self:
+            # replacement: remove silently (no on_evict — the entry is not
+            # leaving the map, it is being refreshed) then re-insert at MRU
             super().__delitem__(key)
         elif len(self) >= self.capacity:
             # not popitem(): the C implementation re-enters the overridden
             # __getitem__ after unlinking the node, and its move_to_end
             # then KeyErrors on the half-removed key
-            oldest = next(iter(self))
-            super().__delitem__(oldest)
+            self.evict_oldest()
         super().__setitem__(key, value)
+        if self.capacity <= 0:
+            self.evict_oldest()
 
     def __getitem__(self, key):
         value = super().__getitem__(key)
         self.move_to_end(key)
         return value
+
+    def popitem(self, last: bool = True):
+        # the C implementation re-enters the overridden __getitem__ after
+        # unlinking the node, and its move_to_end then KeyErrors on the
+        # half-removed key (the PR 2 eviction bug) — pop through the
+        # non-rotating reads instead
+        if not self:
+            raise KeyError("popitem(): map is empty")
+        key = next(reversed(self)) if last else next(iter(self))
+        value = OrderedDict.__getitem__(self, key)
+        super().__delitem__(key)
+        return key, value
+
+
+class SynchronizedLRUMap(LRUMap):
+    """Thread-guarded LRUMap: item access, insertion, deletion, get/pop/
+    popitem/setdefault/update/clear and eviction — reads included, since
+    a hit rotates the recency order — run under one RLock (reentrant:
+    ``__setitem__`` calls ``evict_oldest`` with the lock already held).
+    Iteration and the keys/values/items views are NOT guarded: snapshot
+    under your own coordination if the map is being mutated concurrently.
+
+    This makes individual map operations safe to share across threads; it
+    does NOT make compound check-then-act sequences atomic. A caller whose
+    lookup, insert and side accounting must commit together (the serving
+    score cache's byte budget + hit counters) still needs its own outer
+    lock around a plain `LRUMap` — pinned in tests/test_collections.py.
+    """
+
+    def __init__(self, capacity: int,
+                 on_evict: Optional[Callable[[Any, Any], None]] = None):
+        super().__init__(capacity, on_evict)
+        self._lock = threading.RLock()
+
+    def evict_oldest(self):
+        with self._lock:
+            return super().evict_oldest()
+
+    def __setitem__(self, key, value):
+        with self._lock:
+            super().__setitem__(key, value)
+
+    def __getitem__(self, key):
+        with self._lock:
+            return super().__getitem__(key)
+
+    def __delitem__(self, key):
+        with self._lock:
+            super().__delitem__(key)
+
+    def __contains__(self, key):
+        with self._lock:
+            return super().__contains__(key)
+
+    def __len__(self):
+        with self._lock:
+            return super().__len__()
+
+    def get(self, key, default=None):
+        with self._lock:
+            return super().get(key, default)
+
+    def pop(self, key, *default):
+        with self._lock:
+            return super().pop(key, *default)
+
+    def popitem(self, last: bool = True):
+        with self._lock:
+            return super().popitem(last)
+
+    def setdefault(self, key, default=None):
+        with self._lock:
+            return super().setdefault(key, default)
+
+    def update(self, *args, **kwargs):
+        with self._lock:
+            super().update(*args, **kwargs)
+
+    def clear(self):
+        with self._lock:
+            super().clear()
 
 
 class IndexedSet(Generic[T]):

@@ -63,6 +63,17 @@ device_byte_budget the single-device load must REFUSE
 artifact within budget. --smoke additionally gates zero steady-state
 recompiles on every placement (tier-1 gate in scripts/test.sh).
 
+`--skew` switches to the Zipfian hot-row workload (docs/serving.md "Score
+caching & coalescing"): one model deploys cache-on and cache-off into a
+registry, per-trial fresh pinned-Zipf request streams drive both arms in
+interleaved paired trials through ``registry.submit`` (the batcher front
+the cache lives on), and the BENCH JSON reports effective rows/sec per
+arm, the paired speedup, and the measured hit ratio — with hard gates on
+the speedup floor, the hit-ratio floor, cached == computed BIT-parity at
+every precision (f32/bf16/int8), a mid-bench hot-swap that must fail zero
+requests and never label an old version's score with the new version, and
+zero steady-state recompiles. ``--smoke`` is tier-1 gate 10.
+
 `--overload` switches to the overload sweep (docs/serving.md "Overload
 behavior"): a closed-loop calibration pins the saturation throughput,
 then stepped open-loop offered load (0.25x .. 2x saturation) drives
@@ -1109,6 +1120,304 @@ def _run_overload_mode(args) -> int:
     return rc
 
 
+# -- skew mode: the hot-row cache under Zipfian traffic ----------------------
+
+def _zipf_probs(universe: int, s: float) -> np.ndarray:
+    """Pinned-Zipf rank probabilities: p(r) ~ r^-s over the row universe
+    (the production shape — PAPERS.md ads-infra repetition, hashed-feature
+    mass concentration)."""
+    ranks = np.arange(1, universe + 1, dtype=np.float64)
+    p = ranks ** -s
+    return p / p.sum()
+
+
+def _zipf_stream(universe_rows, probs, n_requests: int, k: int, seed: int):
+    """One request stream: each request is ``k`` rows drawn i.i.d. from
+    the pinned-Zipf distribution over the row universe. Fresh seed per
+    trial — repetition comes from the DISTRIBUTION, not pool identity."""
+    rng = np.random.RandomState(seed)
+    draws = rng.choice(len(universe_rows), size=(n_requests, k), p=probs)
+    return [[universe_rows[i] for i in req] for req in draws]
+
+
+def _registry_closed_loop(registry, name, pool, concurrency: int):
+    """Closed loop over ``registry.submit`` — the batcher-front path the
+    hot-row cache actually lives on (engine-direct driving would bypass
+    it). Returns (wall_seconds, errors)."""
+    errors = []
+    lock = threading.Lock()
+    it = iter(pool)
+
+    def worker():
+        while True:
+            with lock:
+                req = next(it, None)
+            if req is None:
+                return
+            try:
+                _, fut = registry.submit(name, req)
+                fut.result(timeout=60)
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, errors
+
+
+def _skew_swap_probe(registry, name, probe, model2, concurrency: int = 4):
+    """Hammer the cache-fronted model with one fixed (hence hot-cached)
+    request while deploying v2 over v1. Every observation must be
+    (version, that version's OWN score) — a stale v1 score labeled v2 is
+    the bug the version-keyed cache exists to make impossible — and a
+    swap must fail zero requests."""
+    expected = {"1": [float(x)
+                      for x in registry.get(name).engine.predict(probe)]}
+    observed, failures = [], []
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                entry, fut = registry.submit(name, probe)
+                scores = [float(x) for x in fut.result(timeout=30)]
+                with lock:
+                    observed.append((entry.version, scores))
+            except Exception as e:
+                with lock:
+                    failures.append(repr(e))
+
+    threads = [threading.Thread(target=hammer) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    registry.deploy(name, model2, version="2")
+    expected["2"] = [float(x)
+                     for x in registry.get(name).engine.predict(probe)]
+    time.sleep(0.15)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    versions = sorted({v for v, _ in observed})
+    mislabeled = sum(1 for v, s in observed if s != expected[v])
+    return {
+        "requests_served": len(observed),
+        "failed_requests": len(failures),
+        "failures": failures[:3],
+        "versions_observed": versions,
+        "mislabeled_scores": mislabeled,
+        "ok": (not failures and versions == ["1", "2"]
+               and mislabeled == 0),
+    }
+
+
+def _skew_parity_gate(model, probe_pool, args) -> dict:
+    """The hard parity pin: for every serving precision (f32 / bf16 /
+    int8), scores served THROUGH the cache (second pass, all hits) are
+    bit-identical to the first-pass computed ones AND to a cache-off
+    deploy of the same artifact. Exact float equality — quantized
+    precisions compare against their own computed scores, not f32's."""
+    import os
+    import tempfile
+
+    from hivemall_tpu.serving import ModelRegistry, freeze
+
+    tmp = tempfile.mkdtemp(prefix="hivemall_skew_parity_")
+    out = {}
+    ok = True
+    for prec in QUANT_PRECISIONS:
+        path = os.path.join(tmp, prec)
+        freeze(model, path, name=f"skewpar_{prec}", version="1",
+               quantize=_QUANT_FREEZE_ARG[prec])
+        reg = ModelRegistry(score_cache_bytes=args.cache_mb << 20,
+                            engine_kwargs={"max_batch": args.max_batch,
+                                           "max_width": args.max_width})
+        reg.deploy("on", path, version="1")
+        reg.deploy("off", path, version="1", score_cache_bytes=0)
+        computed, cached, offline = [], [], []
+        for req in probe_pool:
+            computed.append([float(x)
+                             for x in reg.submit("on", req)[1].result(30)])
+        hits0 = reg.get("on").describe()["cache"]["hit_rows"]
+        for req in probe_pool:
+            cached.append([float(x)
+                           for x in reg.submit("on", req)[1].result(30)])
+        hits1 = reg.get("on").describe()["cache"]["hit_rows"]
+        for req in probe_pool:
+            offline.append([float(x)
+                            for x in reg.submit("off", req)[1].result(30)])
+        n_rows = sum(len(r) for r in probe_pool)
+        prec_ok = (cached == computed == offline
+                   and hits1 - hits0 == n_rows)
+        out[prec] = {"ok": prec_ok,
+                     "rows": n_rows,
+                     "second_pass_hit_rows": int(hits1 - hits0),
+                     "bit_identical": cached == computed == offline}
+        ok = ok and prec_ok
+        reg.shutdown()
+    out["ok"] = ok
+    return out
+
+
+def run_skew_mode(args) -> int:
+    """Zipfian hot-row workload: cache-on vs cache-off at equal skew.
+
+    One AROW model deploys twice into one registry — ``skew_on`` fronted
+    by the hot-row score cache (serving/cache.py), ``skew_off`` with the
+    cache disabled — and per-trial FRESH pinned-Zipf request streams
+    drive both through ``registry.submit`` (the batcher path the cache
+    lives on) in interleaved paired trials. Hard gates: effective
+    rows/sec (cache-on / cache-off, paired median) >= --skew-speedup-min,
+    measured hit ratio over the timed window >= --skew-hit-floor, the
+    cached == computed bit-parity pin at every precision (f32/bf16/int8),
+    a mid-bench hot-swap with zero failed requests and zero stale-labeled
+    scores, and zero steady-state recompiles."""
+    from hivemall_tpu.serving import ModelRegistry
+
+    model, _rows = _train_default(args.dims, args.train_rows)
+    model2, _ = _train_default(args.dims, args.train_rows, seed=11)
+
+    # the row universe: distinct rows whose ranks carry the Zipf mass
+    rng = np.random.RandomState(17)
+    universe = [[f"{rng.randint(args.dims)}:{rng.rand():.3f}"
+                 for _ in range(rng.randint(4, 14))]
+                for _ in range(args.universe_rows)]
+    probs = _zipf_probs(args.universe_rows, args.zipf_s)
+    k = max(1, int(args.instances_per_request))
+
+    cache_bytes = args.cache_mb << 20
+    registry = ModelRegistry(engine_kwargs={"max_batch": args.max_batch,
+                                            "max_width": args.max_width})
+    registry.deploy("skew_on", model, version="1",
+                    score_cache_bytes=cache_bytes)
+    registry.deploy("skew_off", model, version="1", score_cache_bytes=0)
+
+    # warm pass (untimed, both arms): first-touch costs out of the way
+    # and the cache at its Zipf steady state — what a long-running server
+    # actually serves; the cold ramp is visible in the warm_pass block
+    warm_stream = _zipf_stream(universe, probs, args.requests, k, seed=100)
+    for name in ("skew_on", "skew_off"):
+        _, errs = _registry_closed_loop(registry, name, warm_stream,
+                                        args.concurrency)
+        if errs:
+            print(f"SKEW FAIL: warm pass errors on {name}: {errs[:3]}",
+                  file=sys.stderr)
+            return 1
+    warm_stats = registry.get("skew_on").describe()["cache"]
+
+    guards = {n: REGISTRY.counter("graftcheck", f"recompiles.serving.{n}")
+              for n in ("skew_on", "skew_off")}
+    recompiles0 = {n: g.value for n, g in guards.items()}
+    hit0 = registry.get("skew_on").cache.stats()
+    arms = ("skew_on", "skew_off")
+    trials = {n: [] for n in arms}
+    errors = {n: 0 for n in arms}
+    rows_per_trial = args.requests * k
+    for t in range(args.quant_trials):
+        stream = _zipf_stream(universe, probs, args.requests, k,
+                              seed=200 + t)
+        order = arms if t % 2 == 0 else arms[::-1]
+        for name in order:
+            wall, errs = _registry_closed_loop(registry, name, stream,
+                                               args.concurrency)
+            errors[name] += len(errs)
+            trials[name].append(rows_per_trial / wall)
+    steady = {n: int(guards[n].value - recompiles0[n]) for n in arms}
+    hit1 = registry.get("skew_on").cache.stats()
+    looked = (hit1["hit_rows"] - hit0["hit_rows"]
+              + hit1["miss_rows"] - hit0["miss_rows"])
+    hit_ratio = ((hit1["hit_rows"] - hit0["hit_rows"]) / looked
+                 if looked else 0.0)
+
+    speedup = float(np.median(np.asarray(trials["skew_on"])
+                              / np.asarray(trials["skew_off"])))
+
+    # mid-bench hot swap on the cache-fronted arm: zero failures, both
+    # versions observed, every score labeled with the version that
+    # actually computed it (the version-key invalidation made auditable)
+    probe = _zipf_stream(universe, probs, 1, k, seed=999)[0]
+    swap = _skew_swap_probe(registry, "skew_on", probe, model2,
+                            concurrency=min(4, args.concurrency))
+    cache_stats = registry.get("skew_on").cache.stats()
+    registry.shutdown()
+
+    # cached == computed, bit-identical, at every precision
+    parity = _skew_parity_gate(model,
+                               _zipf_stream(universe, probs, 8, k,
+                                            seed=555),
+                               args)
+
+    meth = {"name": "zipf_closed_loop_paired_trials_registry",
+            "execution_backend": "serving_registry",
+            "dims": int(args.dims),
+            "concurrency": int(args.concurrency),
+            "zipf_s": float(args.zipf_s),
+            "universe_rows": int(args.universe_rows),
+            "rows_per_request": k,
+            "cache_budget_bytes": int(cache_bytes)}
+    result = {
+        "metric": f"serving_skew_cache_speedup_arow_{args.dims}dims",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "methodology": meth,
+        "device_set": _device_set(),
+        "trials": int(args.quant_trials),
+        "requests_per_trial": int(args.requests),
+        "rows_per_trial": int(rows_per_trial),
+        "arms": {
+            n: {"effective_rows_per_sec":
+                round(float(np.median(trials[n])), 1),
+                "steady_state_recompiles": steady[n],
+                "request_errors": errors[n]} for n in arms
+        },
+        "warm_pass": {"hit_ratio": warm_stats["hit_ratio"],
+                      "entries": warm_stats["entries"],
+                      "resident_bytes": warm_stats["resident_bytes"]},
+        "hit_ratio": round(hit_ratio, 4),
+        "coalesced_rows": int(hit1["coalesced_rows"]
+                              - hit0["coalesced_rows"]),
+        "cache": cache_stats,
+        "hot_swap": swap,
+        "parity": parity,
+        "gates": {"speedup_min_x": args.skew_speedup_min,
+                  "hit_floor": args.skew_hit_floor},
+    }
+    print(json.dumps(result))
+
+    rc = 0
+    if speedup < args.skew_speedup_min:
+        print(f"SKEW FAIL: cache-on effective rows/sec is {speedup:.3f}x "
+              f"cache-off at zipf_s={args.zipf_s} — below the "
+              f"{args.skew_speedup_min}x gate", file=sys.stderr)
+        rc = 1
+    if hit_ratio < args.skew_hit_floor:
+        print(f"SKEW FAIL: measured hit ratio {hit_ratio:.4f} below the "
+              f"pinned floor {args.skew_hit_floor}", file=sys.stderr)
+        rc = 1
+    if not parity["ok"]:
+        print(f"SKEW FAIL: cached scores are not bit-identical to "
+              f"computed ones: {json.dumps(parity)}", file=sys.stderr)
+        rc = 1
+    if not swap["ok"]:
+        print(f"SKEW FAIL: hot-swap probe: {json.dumps(swap)}",
+              file=sys.stderr)
+        rc = 1
+    if any(steady.values()):
+        print(f"SKEW FAIL: steady_state_recompiles={steady}",
+              file=sys.stderr)
+        rc = 1
+    if any(errors.values()):
+        print(f"SKEW FAIL: request errors {errors}", file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def closed_loop(batcher, pool, concurrency: int):
     lat, errors = [], []
     lock = threading.Lock()
@@ -1439,6 +1748,30 @@ def main() -> int:
                          "peak goodput (hard gate)")
     ap.add_argument("--max-workers", type=int, default=48,
                     help="open-loop client thread cap per step")
+    ap.add_argument("--skew", action="store_true",
+                    help="Zipfian hot-row workload: cache-on vs cache-off "
+                         "registry arms at equal skew (serving/cache.py); "
+                         "hard-fails when the paired speedup drops below "
+                         "--skew-speedup-min, hit ratio below "
+                         "--skew-hit-floor, on any cached!=computed "
+                         "parity break, a failed request across the "
+                         "mid-bench hot-swap, or recompiles")
+    ap.add_argument("--zipf-s", type=float, default=1.2,
+                    help="Zipf exponent of the request-row distribution "
+                         "(pinned; recorded in the methodology dict)")
+    ap.add_argument("--universe-rows", type=int, default=None,
+                    help="distinct rows the Zipf mass spreads over; "
+                         "default 8000 (400 under --smoke)")
+    ap.add_argument("--cache-mb", type=int, default=None,
+                    help="hot-row cache byte budget in MB; default 64 "
+                         "(8 under --smoke)")
+    ap.add_argument("--skew-speedup-min", type=float, default=None,
+                    help="min cache-on/cache-off effective rows/sec "
+                         "(hard gate); default 1.5 (1.3 under --smoke)")
+    ap.add_argument("--skew-hit-floor", type=float, default=None,
+                    help="min measured cache-hit ratio over the timed "
+                         "window (hard gate); default 0.6 (0.5 under "
+                         "--smoke)")
     ap.add_argument("--sharded", action="store_true",
                     help="sharded-placement bench: single-device vs "
                          "NamedSharding servables per (batch, model) mesh "
@@ -1499,6 +1832,24 @@ def main() -> int:
                        "concurrency": (0, 2),
                        "max_batch": (1024, 64),
                        "instances_per_request": (512, 16)})
+    if args.skew:
+        # the skew bench sizes for DISPATCH-bound misses: a table big
+        # enough that a miss pays a real gather-dot (that is what a hit
+        # skips), requests small enough that full-request coverage is
+        # common at the pinned skew, and a universe the Zipf head
+        # concentrates on. The cache budget comfortably holds the touched
+        # set — byte-budget eviction is pinned in unit tests; what the
+        # bench measures is the steady-state fast path.
+        sizing.update({"dims": (1 << 20, 1 << 10),
+                       "train_rows": (20000, 300),
+                       "requests": (2500, 300),
+                       "concurrency": (8, 4),
+                       "max_batch": (256, 64),
+                       "instances_per_request": (4, 2),
+                       "universe_rows": (8000, 400),
+                       "cache_mb": (64, 8),
+                       "skew_speedup_min": (1.5, 1.3),
+                       "skew_hit_floor": (0.6, 0.5)})
     if args.quantize:
         # the quantized bench sizes for table-bandwidth sensitivity: a
         # 2^24-dim f32 weight table (64 MB) is past any cache this host
@@ -1521,11 +1872,20 @@ def main() -> int:
             setattr(args, name, small if args.smoke else full)
 
     if args.overload:
-        if args.artifact or args.http or args.quantize or args.sharded:
+        if args.artifact or args.http or args.quantize or args.sharded \
+                or args.skew:
             raise SystemExit("--overload trains and deploys its own model; "
                              "it does not compose with --artifact, --http, "
-                             "--quantize or --sharded")
+                             "--quantize, --sharded or --skew")
         return run_overload_mode(args)
+
+    if args.skew:
+        if args.artifact or args.http or args.quantize or args.sharded:
+            raise SystemExit("--skew trains and deploys its own model "
+                             "twice (cache-on / cache-off); it does not "
+                             "compose with --artifact, --http, --quantize "
+                             "or --sharded")
+        return run_skew_mode(args)
 
     if args.sharded:
         if args.artifact or args.http or args.quantize:

@@ -104,4 +104,15 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_pipeline.py --smoke
 
+# tier-1 gate 10: hot-row cache smoke — a pinned-Zipf closed-loop workload
+# against cache-on vs cache-off registry arms must show effective rows/sec
+# >= 1.3x cache-off at the smoke skew with the measured hit ratio above
+# the pinned floor, cached scores BIT-identical to computed ones at every
+# precision (f32/bf16/int8), zero failed requests across the mid-bench
+# hot-swap (and zero scores labeled with a version that did not compute
+# them), and zero steady-state recompiles (docs/serving.md "Score caching
+# & coalescing"; prints one BENCH-style JSON line)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_serving.py --skew --smoke
+
 exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"

@@ -1,10 +1,13 @@
 """Collection-substrate tests (ref: utils/collections/*Test, common/ReservoirSampler)."""
 
+import threading
+
 import numpy as np
 
 from hivemall_tpu.utils.collections import (BoundedPriorityQueue, IndexedSet,
                                             LRUMap, ReservoirSampler,
-                                            SparseIntArray)
+                                            SparseIntArray,
+                                            SynchronizedLRUMap)
 
 
 def test_bounded_priority_queue():
@@ -22,6 +25,102 @@ def test_lru_map():
     _ = m["a"]  # touch
     m["c"] = 3  # evicts b
     assert "b" not in m and "a" in m and "c" in m
+
+
+def test_lru_map_eviction_order_under_mixed_hit_insert():
+    """The on_evict hook observes evictions in exact LRU order, with hits
+    rotating recency and replacements NOT firing the hook (the entry is
+    refreshed, not dropped) — the contract serving/cache.py's byte
+    accounting builds on."""
+    evicted = []
+    m = LRUMap(3, on_evict=lambda k, v: evicted.append((k, v)))
+    m["a"] = 1
+    m["b"] = 2
+    m["c"] = 3
+    _ = m["a"]       # a is now MRU; LRU order is b, c, a
+    m["b"] = 20      # replacement: refreshes b to MRU, NO eviction
+    assert evicted == []
+    m["d"] = 4       # evicts c (the oldest untouched entry)
+    _ = m["a"]       # rotate again: LRU order is b, d, a
+    m["e"] = 5       # evicts b
+    assert evicted == [("c", 3), ("b", 20)]
+    assert list(m) == ["d", "a", "e"]
+    # dict.get is the documented no-rotation peek
+    lru_before = next(iter(m))
+    assert m.get(lru_before) == 4
+    assert next(iter(m)) == lru_before
+
+
+def test_lru_map_capacity_edges():
+    """capacity 0 holds nothing (every insert immediately evicts through
+    the hook — a zero-budget cache stays consistent instead of raising);
+    capacity 1 is a working single-entry LRU."""
+    evicted = []
+    z = LRUMap(0, on_evict=lambda k, v: evicted.append((k, v)))
+    z["a"] = 1
+    assert len(z) == 0 and evicted == [("a", 1)]
+    one = LRUMap(1, on_evict=lambda k, v: evicted.append((k, v)))
+    one["a"] = 1
+    one["a"] = 2     # replacement at capacity: no eviction
+    one["b"] = 3     # evicts the refreshed a
+    assert dict(one) == {"b": 3}
+    assert evicted == [("a", 1), ("a", 2)]
+
+
+def test_lru_map_evict_oldest_explicit():
+    m = LRUMap(4)
+    assert m.evict_oldest() is None
+    m["a"] = 1
+    m["b"] = 2
+    _ = m["a"]
+    assert m.evict_oldest() == ("b", 2)  # eviction never rotates recency
+    assert dict(m) == {"a": 1}
+
+
+def test_lru_map_popitem_is_reentrancy_safe():
+    """The C popitem re-enters the overridden __getitem__ on the
+    half-removed node (the PR 2 eviction bug); the override pops through
+    the non-rotating reads instead — both ends, plus the empty edge."""
+    import pytest
+
+    m = LRUMap(4)
+    m["a"] = 1
+    m["b"] = 2
+    _ = m["a"]  # recency order: b, a
+    assert m.popitem() == ("a", 1)  # MRU end
+    assert m.popitem(last=False) == ("b", 2)  # LRU end
+    with pytest.raises(KeyError):
+        m.popitem()
+
+
+def test_synchronized_lru_map_concurrent_hammer():
+    """N threads of mixed get/set never corrupt the map or exceed
+    capacity; the RLock makes the __setitem__ -> evict_oldest re-entry
+    safe. Compound sequences still need an outer lock (the serving cache
+    holds its own around a plain LRUMap — see serving/cache.py)."""
+    m = SynchronizedLRUMap(32)
+    errors = []
+
+    def hammer(seed):
+        rng = np.random.RandomState(seed)
+        try:
+            for _ in range(500):
+                k = int(rng.randint(64))
+                if rng.rand() < 0.5:
+                    m[k] = k
+                else:
+                    assert m.get(k, k) == k
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=hammer, args=(s,)) for s in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert len(m) <= 32
+    assert m.evict_oldest() is not None
 
 
 def test_indexed_set():

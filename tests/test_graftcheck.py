@@ -446,6 +446,29 @@ def test_batch_update_module_is_always_hot():
     assert "G017" not in cold, cold
 
 
+def test_serving_cache_module_is_always_hot():
+    """PR 15: the hot-row score cache joined the G017/G019 always-hot
+    scope — a synthetic silent promotion written as if inside
+    serving/cache.py fires WITHOUT any traced/step-shaped context — and
+    its concurrency discipline rides the G012-G016 serving/ prefix (the
+    clean pin below scans the whole serving tree, cache.py included)."""
+    from hivemall_tpu.analysis import config
+
+    assert "hivemall_tpu/serving/cache.py" in \
+        config.DTYPEFLOW_HOT_MODULES
+    assert any("hivemall_tpu/serving/cache.py".startswith(p)
+               for p in config.CONCURRENCY_HOT_PREFIXES)
+    src = (
+        "import jax.numpy as jnp\n\n\n"
+        "def helper():\n"
+        "    table = jnp.zeros((64,), jnp.bfloat16)\n"
+        "    scale = jnp.ones((64,), jnp.float32)\n"
+        "    return table * scale\n")
+    hits = [f.rule for f in analyze_source(
+        src, "hivemall_tpu/serving/cache.py")]
+    assert "G017" in hits, hits
+
+
 def test_output_flag_writes_sarif_artifact(tmp_path):
     """--format sarif --output FILE (the scripts/lint.sh CI wiring): the
     SARIF payload lands in the file, stdout keeps the text summary, and

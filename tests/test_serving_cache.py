@@ -1,0 +1,493 @@
+"""Hot-row score cache + in-flight coalescing pins (serving/cache.py):
+byte-budget eviction, version-exact keying, quota/queue bypass on hits,
+coalescing correctness under failure (shed / deadline / engine error),
+swap-time invalidation, and the observability surface."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hivemall_tpu.runtime.metrics import REGISTRY
+from hivemall_tpu.serving import (DeadlineExpired, DynamicBatcher,
+                                  ModelRegistry, QueueFull, ScoreCache,
+                                  ShedLowPriority)
+from hivemall_tpu.serving.cache import _entry_cost
+
+
+def _keyfn(instances):
+    """A toy canonical key fn: each instance keys on its repr (the engine
+    supplies blake2b digests over the pre-parsed form in production)."""
+    return [repr(r).encode() for r in instances]
+
+
+def _cached_batcher(name, predict, *, bytes_=1 << 20, version="1", **kw):
+    cache = ScoreCache(bytes_, name=name)
+    b = DynamicBatcher(predict, name=name, cache=cache,
+                       cache_version=version, row_key_fn=_keyfn, **kw)
+    return b, cache
+
+
+# -- ScoreCache unit behavior -------------------------------------------------
+
+def test_byte_budget_evicts_oldest_first():
+    cache = ScoreCache(3 * _entry_cost(("1", b"x" * 16), 1.0), name="sc_bb")
+    b = DynamicBatcher(lambda rows: [float(r) for r in rows], name="sc_bb",
+                       cache=cache, cache_version="1", row_key_fn=_keyfn,
+                       max_delay_ms=0.5)
+    try:
+        for r in (10, 11, 12, 13):  # 4 distinct rows through a 3-entry budget
+            b.submit([r]).result(5)
+        st = cache.stats()
+        assert st["entries"] == 3
+        assert st["evicted_entries"] == 1
+        assert st["resident_bytes"] <= cache.max_bytes
+        # the evicted entry is the OLDEST (row 10): re-requesting it is a
+        # miss, re-requesting row 13 is a hit
+        h0 = st["hit_rows"]
+        b.submit([13]).result(5)
+        assert cache.stats()["hit_rows"] == h0 + 1
+        b.submit([10]).result(5)
+        assert cache.stats()["hit_rows"] == h0 + 1  # 10 was recomputed
+    finally:
+        b.close()
+
+
+def test_version_is_in_the_key():
+    """The same row under a different version is a MISS — the whole
+    hot-swap invalidation story (no flush anywhere)."""
+    calls = []
+
+    def predict(rows):
+        calls.append(list(rows))
+        return [float(r) for r in rows]
+
+    cache = ScoreCache(1 << 20, name="sc_ver")
+    b1 = DynamicBatcher(predict, name="sc_ver", cache=cache,
+                        cache_version="1", row_key_fn=_keyfn)
+    assert b1.submit([7]).result(5) == [7.0]
+    assert b1.submit([7]).result(5) == [7.0]
+    assert len(calls) == 1  # second was a hit
+    b1.close()
+    b2 = DynamicBatcher(predict, name="sc_ver", cache=cache,
+                        cache_version="2", row_key_fn=_keyfn)
+    assert b2.submit([7]).result(5) == [7.0]
+    assert len(calls) == 2  # new version: recomputed
+    b2.close()
+    st = cache.stats()
+    assert st["hit_rows"] == 1 and st["miss_rows"] == 2
+
+
+def test_zero_budget_cache_refused():
+    with pytest.raises(ValueError):
+        ScoreCache(0, name="sc_zero")
+
+
+# -- the admission bypass -----------------------------------------------------
+
+def test_hit_bypasses_queue_capacity_and_quota():
+    """A fully-cached request resolves while the queue is FULL and the
+    worker is wedged — it consumed no queue rows, no class quota, no
+    batch slot (the ISSUE's goodput contract)."""
+    gate = threading.Event()
+    first = threading.Event()
+
+    def predict(rows):
+        first.set()
+        gate.wait(10)
+        return [float(r) for r in rows]
+
+    b, cache = _cached_batcher("sc_bypass", predict, max_batch=1,
+                               max_delay_ms=0.5, max_queue_rows=2,
+                               express_high=False)
+    try:
+        warm = b.submit([1])  # will wedge in predict
+        assert first.wait(5)
+        fills = [b.submit([100 + i]) for i in range(2)]  # queue now full
+        with pytest.raises(QueueFull):
+            b.submit([999])
+        gate.set()
+        warm.result(5)  # row 1 now cached
+        for f in fills:
+            f.result(5)
+        gate.clear()
+        first.clear()
+        blocker = b.submit([200])  # wedge the worker again
+        assert first.wait(5)  # worker holds it — queue is empty again
+        refill = [b.submit([300 + i]) for i in range(2)]
+        with pytest.raises(QueueFull):
+            b.submit([999])
+        # the cached row sails through the full queue, instantly
+        hit = b.submit([1])
+        assert hit.done() and hit.result() == [1.0]
+        gate.set()
+        blocker.result(5)
+        for f in refill:
+            f.result(5)
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_coalescing_shares_one_computation():
+    calls = []
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def predict(rows):
+        calls.append(list(rows))
+        entered.set()
+        gate.wait(10)
+        return [float(r) for r in rows]
+
+    b, cache = _cached_batcher("sc_coal", predict, max_delay_ms=0.5)
+    try:
+        leader = b.submit([5, 6])
+        assert entered.wait(5)  # leader is mid-dispatch (still in flight)
+        followers = [b.submit([5, 6]) for _ in range(3)]
+        assert all(not f.done() for f in followers)
+        gate.set()
+        assert leader.result(5) == [5.0, 6.0]
+        for f in followers:
+            assert f.result(5) == [5.0, 6.0]
+        assert len(calls) == 1  # ONE computation for 4 requests
+        st = cache.stats()
+        assert st["coalesced_rows"] == 6 and st["miss_rows"] == 2
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_partial_coverage_flows_unchanged():
+    """A request with any uncovered row computes EVERYTHING itself (no
+    request splitting) and its fresh rows join the cache."""
+    calls = []
+
+    def predict(rows):
+        calls.append(list(rows))
+        return [float(r) for r in rows]
+
+    b, cache = _cached_batcher("sc_part", predict, max_delay_ms=0.5)
+    try:
+        b.submit([1, 2]).result(5)
+        assert b.submit([2, 3]).result(5) == [2.0, 3.0]  # 2 cached, 3 new
+        assert [2, 3] in calls  # both rows recomputed — flows unchanged
+        assert b.submit([3]).result(5) == [3.0]
+        assert cache.stats()["miss_rows"] == 4  # 1,2 then 2,3
+        assert cache.stats()["hit_rows"] == 1  # the final [3]
+    finally:
+        b.close()
+
+
+# -- coalescing correctness under failure ------------------------------------
+
+def test_leader_engine_error_fails_followers_same_reason_no_populate():
+    """Fault-injected dispatch: the leader's engine error propagates to
+    every follower VERBATIM and the cache stays unpopulated — the next
+    request recomputes (and succeeds)."""
+    boom = [True]
+    gate = threading.Event()
+    entered = threading.Event()
+    calls = []
+
+    def predict(rows):
+        calls.append(list(rows))
+        entered.set()
+        gate.wait(10)
+        if boom[0]:
+            raise RuntimeError("injected scorer fault")
+        return [float(r) for r in rows]
+
+    b, cache = _cached_batcher("sc_fault", predict, max_delay_ms=0.5)
+    try:
+        leader = b.submit([9])
+        assert entered.wait(5)
+        follower = b.submit([9])
+        gate.set()
+        with pytest.raises(RuntimeError, match="injected scorer fault"):
+            leader.result(5)
+        with pytest.raises(RuntimeError, match="injected scorer fault"):
+            follower.result(5)
+        assert cache.stats()["entries"] == 0  # failure populated NOTHING
+        boom[0] = False
+        assert b.submit([9]).result(5) == [9.0]  # recomputed, now cached
+        assert len(calls) == 2
+        assert cache.stats()["entries"] == 1
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_leader_shed_fails_followers_with_shed_reason():
+    """A low-priority leader evicted for higher-priority work takes its
+    followers down with the SAME ShedLowPriority."""
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def predict(rows):
+        entered.set()
+        gate.wait(10)
+        return [float(r) for r in rows]
+
+    b, cache = _cached_batcher("sc_shed", predict, max_batch=1,
+                               max_delay_ms=0.5, max_queue_rows=2,
+                               priority_quota_fracs=(1.0, 0.85, 0.6),
+                               express_high=False)
+    try:
+        wedge = b.submit([1])  # occupies the worker
+        assert entered.wait(5)
+        leader = b.submit([50], priority="low")  # queued, leads key 50
+        follower = b.submit([50], priority="low")  # coalesces onto it
+        # two high arrivals: quota math sheds the newest low-priority
+        # queued work — the leader
+        high = [b.submit([60 + i], priority="high") for i in range(2)]
+        with pytest.raises(ShedLowPriority):
+            leader.result(5)
+        with pytest.raises(ShedLowPriority):
+            follower.result(5)
+        gate.set()
+        wedge.result(5)
+        for f in high:
+            f.result(5)
+        assert cache.stats()["entries"] == 3  # 1, 60, 61 — never 50
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_leader_deadline_expiry_fails_followers_as_deadline():
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def predict(rows):
+        entered.set()
+        gate.wait(10)
+        return [float(r) for r in rows]
+
+    b, cache = _cached_batcher("sc_dead", predict, max_batch=1,
+                               max_delay_ms=0.5, express_high=False)
+    try:
+        wedge = b.submit([1])
+        assert entered.wait(5)
+        leader = b.submit([70], deadline_ms=30)
+        follower = b.submit([70])
+        time.sleep(0.08)  # the deadline passes while queued behind the wedge
+        gate.set()  # wedge returns; the worker purges the expired head
+        with pytest.raises(DeadlineExpired):
+            leader.result(5)  # expired IN the queue — never dispatched
+        with pytest.raises(DeadlineExpired):
+            follower.result(5)
+        wedge.result(5)
+        assert b.submit([70]).result(5) == [70.0]  # never cached stale
+    finally:
+        gate.set()
+        b.close()
+
+
+def test_quota_refused_leader_registers_nothing():
+    """A leader refused at admission (QueueFull) never took leadership
+    (lead() runs only after a successful enqueue), so no follower can be
+    stranded on an admission error and the next identical request is not
+    stuck waiting on a ghost."""
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def predict(rows):
+        entered.set()
+        gate.wait(10)
+        return [float(r) for r in rows]
+
+    b, cache = _cached_batcher("sc_abort", predict, max_batch=1,
+                               max_delay_ms=0.5, max_queue_rows=1,
+                               express_high=False)
+    try:
+        wedge = b.submit([1])
+        assert entered.wait(5)
+        filler = b.submit([2])  # queue full now
+        with pytest.raises(QueueFull):
+            b.submit([80])  # would-be leader refused
+        # key 80's leadership was released; keys 1 and 2 stay legitimately
+        # in flight (their leaders are dispatching / queued)
+        assert cache.stats()["inflight_keys"] == 2
+        gate.set()
+        wedge.result(5)
+        filler.result(5)
+        assert cache.stats()["inflight_keys"] == 0
+        assert b.submit([80]).result(5) == [80.0]  # fresh leader works
+    finally:
+        gate.set()
+        b.close()
+
+
+# -- swap-time invalidation ---------------------------------------------------
+
+def _train_tiny(dims=256, seed=7, opts=""):
+    from hivemall_tpu.models.classifier import train_arow
+
+    rng = np.random.RandomState(seed)
+    rows = [[f"{rng.randint(dims)}:{rng.rand():.3f}" for _ in range(5)]
+            for _ in range(120)]
+    labels = rng.choice([-1, 1], 120)
+    return train_arow(rows, labels, f"-dims {dims} {opts}".strip()), rows
+
+
+def test_swap_never_serves_stale_score_under_new_version():
+    """Requests racing a hot-swap either hit the old version's entries
+    (labeled with the old version) or compute fresh on the new one —
+    never a v1 score labeled v2. Version captured at admission, asserted
+    against the response's exact expected score per version."""
+    model1, rows = _train_tiny()
+    model2, _ = _train_tiny(opts="-r 0.7")
+    reg = ModelRegistry(score_cache_bytes=1 << 20,
+                        engine_kwargs={"max_batch": 64, "max_width": 32})
+    reg.deploy("swap", model1, version="1")
+    probe = rows[:2]
+    expected = {
+        "1": [float(x) for x in reg.get("swap").engine.predict(probe)],
+    }
+    e, f = reg.submit("swap", probe)  # cached under v1
+    assert [float(x) for x in f.result(10)] == expected["1"]
+
+    observed = []
+    failures = []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                entry, fut = reg.submit("swap", probe)
+                observed.append((entry.version,
+                                 [float(x) for x in fut.result(10)]))
+            except Exception as exc:  # a swap must fail zero requests
+                failures.append(repr(exc))
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.1)
+    reg.deploy("swap", model2, version="2")
+    expected["2"] = [float(x)
+                     for x in reg.get("swap").engine.predict(probe)]
+    time.sleep(0.1)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    reg.shutdown()
+    assert failures == []
+    assert expected["1"] != expected["2"]  # the models genuinely differ
+    versions = {v for v, _ in observed}
+    assert versions <= {"1", "2"} and "2" in versions
+    for version, scores in observed:
+        assert scores == expected[version], \
+            f"score labeled v{version} is not v{version}'s own score"
+
+
+def test_cached_equals_computed_through_registry():
+    model, rows = _train_tiny()
+    reg = ModelRegistry(score_cache_bytes=1 << 20,
+                        engine_kwargs={"max_batch": 64, "max_width": 32})
+    reg.deploy("par", model, version="1")
+    probe = rows[:8]
+    _, f1 = reg.submit("par", probe)
+    computed = [float(x) for x in f1.result(10)]
+    _, f2 = reg.submit("par", probe)
+    cached = [float(x) for x in f2.result(10)]
+    direct = [float(x) for x in reg.get("par").engine.predict(probe)]
+    reg.shutdown()
+    assert cached == computed == direct  # bit-identical, not approx
+
+
+# -- keys, observability, wiring ---------------------------------------------
+
+def test_engine_row_keys_canonical_across_request_forms():
+    """A string row and its pre-parsed twins (per-row arrays, flat pack)
+    share one key; over-wide rows and unsupported families are None."""
+    from hivemall_tpu.serving import ServingEngine
+
+    model, _ = _train_tiny(dims=128)
+    eng = ServingEngine(model, name="rk", max_batch=16, max_width=8)
+    row_s = ["3:0.5", "7:1.0"]
+    idx = np.asarray([3, 7], np.int64)
+    val = np.asarray([0.5, 1.0], np.float32)
+    k_str = eng.row_keys([row_s])
+    k_pair = eng.row_keys(([idx], [val]))
+    k_flat = eng.row_keys((idx, val, np.asarray([2], np.int64)))
+    assert k_str == k_pair == k_flat
+    assert len(k_str) == 1 and len(k_str[0]) == 16
+    # hashed ids canonicalize mod dims: 3 and 3+128 are the same row
+    assert eng.row_keys(([idx + 128], [val])) == k_str
+    # over-wide rows make the request uncacheable (truncation semantics
+    # live in staging, not here)
+    wide = [[f"{i}:1.0" for i in range(9)]]
+    assert eng.row_keys(wide) is None
+    # different values / different order are different keys
+    assert eng.row_keys([["7:1.0", "3:0.5"]]) != k_str
+
+
+def test_row_keys_unsupported_family_is_none():
+    from hivemall_tpu.models.trees import train_randomforest_classifier
+    from hivemall_tpu.serving import ServingEngine
+
+    rng = np.random.RandomState(3)
+    X = rng.rand(40, 4)
+    y = (X[:, 0] > 0.5).astype(int)
+    model = train_randomforest_classifier(X, y, "-trees 2 -seed 1")
+    eng = ServingEngine(model, name="rk_tree", max_batch=16)
+    assert eng.row_keys([list(X[0])]) is None
+
+
+def test_metrics_and_models_surface():
+    model, rows = _train_tiny()
+    reg = ModelRegistry(score_cache_bytes=1 << 20,
+                        engine_kwargs={"max_batch": 64, "max_width": 32})
+    reg.deploy("obs", model, version="1")
+    reg.submit("obs", rows[:2])[1].result(10)
+    reg.submit("obs", rows[:2])[1].result(10)
+    desc = reg.get("obs").describe()
+    st = desc["cache"]
+    assert st["enabled"] and st["hit_rows"] == 2 and st["miss_rows"] == 2
+    assert st["hit_ratio"] == 0.5
+    assert st["resident_bytes"] > 0 and st["budget_bytes"] == 1 << 20
+    snap = REGISTRY.snapshot()
+    assert snap["serving.obs.cache.resident_bytes"] == st["resident_bytes"]
+    assert snap["serving.obs.cache.hit"] == 2
+    # cache off by default: a second registry reports enabled False
+    reg2 = ModelRegistry(engine_kwargs={"max_batch": 64, "max_width": 32})
+    reg2.deploy("obs_off", model, version="1")
+    assert reg2.get("obs_off").describe()["cache"] == {"enabled": False}
+    reg.shutdown()
+    reg2.shutdown()
+
+
+def test_trace_instants_inside_request_span():
+    from hivemall_tpu.runtime.tracing import TRACER
+
+    def predict(rows):
+        return [float(r) for r in rows]
+
+    b, cache = _cached_batcher("sc_trace", predict, max_delay_ms=0.5)
+    try:
+        TRACER.clear()
+        with TRACER.span("server.predict"):
+            b.submit([1]).result(5)  # miss
+        with TRACER.span("server.predict"):
+            b.submit([1]).result(5)  # hit
+        time.sleep(0.05)
+        events = [e["name"] for t in TRACER.traces()
+                  for s in t["spans"] for e in s.get("events", ())]
+        assert "cache.hit" in events
+    finally:
+        b.close()
+
+
+def test_cache_module_in_dtypeflow_hot_scope():
+    """The graftcheck satellite: serving/cache.py rides the G012-G016
+    concurrency scope via the serving/ prefix AND sits in the G017/G019
+    always-hot dtype scope explicitly."""
+    from hivemall_tpu.analysis import config
+
+    assert "hivemall_tpu/serving/cache.py" in config.DTYPEFLOW_HOT_MODULES
+    assert any("hivemall_tpu/serving/".startswith(p) or
+               "hivemall_tpu/serving/cache.py".startswith(p)
+               for p in config.CONCURRENCY_HOT_PREFIXES)
