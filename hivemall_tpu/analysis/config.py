@@ -189,6 +189,26 @@ ARTIFACT_IO_MODULES = (
 )
 ARTIFACT_MARKER = "# graftcheck: artifact-io"
 
+# --- G022-G026: FFI boundary (v5) ------------------------------------------
+# Exported symbols of the native library (native/hivemall_native.cpp) all
+# share this prefix; any dotted call whose tail matches is a foreign call.
+FFI_SYMBOL_PREFIXES = ("hm_",)
+# Callees whose results are sanctioned pointer sources: they raise on any
+# dtype/rank/contiguity violation, so arrays unpacked from them are
+# ABI-proven (ops/scatter.py plan_abi_arrays — the frozen plan ABI's gate).
+FFI_SANCTIONING_VALIDATORS = ("plan_abi_arrays",)
+# numpy constructors whose result is always freshly allocated C-contiguous;
+# with an explicit dtype they fully validate a pointer source.
+FFI_FRESH_CTORS = ("empty", "zeros", "ones", "full", "frombuffer")
+# The Python-side plan ABI version constant (ops/scatter.py) checked by
+# G025 against the C side's HM_PLAN_ABI_VERSION literal.
+FFI_ABI_VERSION_CONSTANT = "PLAN_ABI_VERSION"
+# C source of the native library for the G025 cross-language check; the
+# env var overrides the default repo-root-relative location (tests seed
+# deliberate drift through a tempdir copy).
+FFI_NATIVE_CPP_ENV = "GRAFTCHECK_NATIVE_CPP"
+FFI_NATIVE_CPP_DEFAULT = "native/hivemall_native.cpp"
+
 # --- G005: donation --------------------------------------------------------
 # jit-wrapped functions whose name looks step-shaped should donate their
 # model-state argument; otherwise every hot-loop step copies the tables.

@@ -49,6 +49,11 @@ class Finding:
     snippet: str  # stripped source of the flagged line (baseline key)
     # optional autofix; not part of identity/baseline and not serialized
     fix: Optional[Fix] = field(default=None, compare=False)
+    # additional (path, line, snippet) locations — G025 points into the C++
+    # source alongside the Python declaration; SARIF renders them as extra
+    # physicalLocations. Not part of identity/baseline and not serialized.
+    related: Tuple[Tuple[str, int, str], ...] = field(default=(),
+                                                     compare=False)
 
     @property
     def key(self):

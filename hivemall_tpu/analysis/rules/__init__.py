@@ -17,7 +17,9 @@ from . import (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
                g013_blocking_under_lock, g014_cv_misuse, g015_thread_leak,
                g016_lock_order_cycle, g017_hot_promotion, g018_f64_leak,
                g019_cast_in_loop, g020_artifact_dtype,
-               g021_low_precision_accum)
+               g021_low_precision_accum, g022_ffi_unvalidated_pointer,
+               g023_ffi_borrowed_buffer, g024_ffi_missing_prototype,
+               g025_ffi_abi_drift, g026_ffi_unchecked_return)
 
 _MODULE_RULES = (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
                  g005_donation, g006_side_effect, g009_api_compat,
@@ -27,7 +29,10 @@ _PROGRAM_RULES = (g007_collective_axis, g008_spec_mesh,
                   g012_unguarded_shared_field, g013_blocking_under_lock,
                   g014_cv_misuse, g016_lock_order_cycle,
                   g017_hot_promotion, g019_cast_in_loop,
-                  g020_artifact_dtype, g021_low_precision_accum)
+                  g020_artifact_dtype, g021_low_precision_accum,
+                  g022_ffi_unvalidated_pointer, g023_ffi_borrowed_buffer,
+                  g024_ffi_missing_prototype, g025_ffi_abi_drift,
+                  g026_ffi_unchecked_return)
 
 ALL_RULES: Dict[str, Callable[[ModuleModel], List[Finding]]] = {
     m.RULE_ID: m.check for m in _MODULE_RULES

@@ -28,7 +28,7 @@ from .findings import Finding, Severity
 SARIF_VERSION = "2.1.0"
 SARIF_SCHEMA = ("https://docs.oasis-open.org/sarif/sarif/v2.1.0/errata01/"
                 "os/schemas/sarif-schema-2.1.0.json")
-TOOL_VERSION = "4.0"
+TOOL_VERSION = "5.0"
 INFO_URI = "https://github.com/hivemall-tpu/hivemall-tpu" \
            "/blob/main/docs/static_analysis.md"
 
@@ -38,6 +38,21 @@ _LEVELS = {Severity.ERROR: "error", Severity.WARNING: "warning"}
 def _fingerprint(f: Finding) -> str:
     key = f"{f.rule}\x1f{f.path}\x1f{f.snippet}"
     return hashlib.sha256(key.encode("utf-8")).hexdigest()[:32]
+
+
+def _location(path: str, line: int, snippet: str) -> dict:
+    return {
+        "physicalLocation": {
+            "artifactLocation": {
+                "uri": path,
+                "uriBaseId": "SRCROOT",
+            },
+            "region": {
+                "startLine": max(1, line),
+                "snippet": {"text": snippet},
+            },
+        },
+    }
 
 
 def render_sarif(findings: Sequence[Finding]) -> dict:
@@ -58,23 +73,18 @@ def render_sarif(findings: Sequence[Finding]) -> dict:
         })
     results: List[dict] = []
     for f in findings:
+        # primary location first; `related` carries the extra ends of a
+        # cross-file finding (G025: the C declaration the Python binding
+        # drifted from) as further physicalLocations in the same list
+        locations = [_location(f.path, f.line, f.snippet)]
+        for r_path, r_line, r_snippet in f.related:
+            locations.append(_location(r_path, r_line, r_snippet))
         results.append({
             "ruleId": f.rule,
             "ruleIndex": rule_index[f.rule],
             "level": _LEVELS.get(f.severity, "error"),
             "message": {"text": f.message},
-            "locations": [{
-                "physicalLocation": {
-                    "artifactLocation": {
-                        "uri": f.path,
-                        "uriBaseId": "SRCROOT",
-                    },
-                    "region": {
-                        "startLine": max(1, f.line),
-                        "snippet": {"text": f.snippet},
-                    },
-                },
-            }],
+            "locations": locations,
             "partialFingerprints": {
                 "graftcheckKey/v1": _fingerprint(f),
             },

@@ -19,28 +19,80 @@ _lib: Optional[ctypes.CDLL] = None
 
 _load_error: Optional[str] = None
 
+# HIVEMALL_TPU_NATIVE_SANITIZE selects a sanitizer-instrumented .so variant
+# built by `scripts/build_native.sh --sanitize=...` (suffixed so the
+# build-stamp machinery never confuses it with the optimized build):
+#   ""     -> libhivemall_native.so       (the optimized default)
+#   "asan" -> libhivemall_native.asan.so  (ASan+UBSan, halt_on_error gate)
+#   "tsan" -> libhivemall_native.tsan.so  (TSan — armed for the threaded
+#                                          native apply)
+# Sanitizer runtimes are not linked into a -shared .so: the test harness
+# LD_PRELOADs libasan/libubsan (scripts/test.sh gate 11).
+_SANITIZE_ENV = "HIVEMALL_TPU_NATIVE_SANITIZE"
+_SANITIZE_SUFFIX = {"": "", "asan": ".asan", "tsan": ".tsan"}
+
+
+def _so_path() -> Optional[str]:
+    """The .so variant selected by the sanitizer env var, or None (with
+    ``_load_error`` recorded) for an unknown value — a typo'd sanitizer
+    name must refuse loudly, never silently load the uninstrumented .so."""
+    global _load_error
+    variant = os.environ.get(_SANITIZE_ENV, "").strip().lower()
+    suffix = _SANITIZE_SUFFIX.get(variant)
+    if suffix is None:
+        _load_error = (f"unknown {_SANITIZE_ENV}={variant!r} "
+                       f"(expected one of: "
+                       f"{', '.join(repr(k) for k in _SANITIZE_SUFFIX)})")
+        import warnings
+
+        warnings.warn(f"hivemall_tpu.native: {_load_error}; native "
+                      f"backend disabled, using Python fallbacks")
+        return None
+    if not suffix:
+        return _LIB_PATH
+    base, ext = os.path.splitext(_LIB_PATH)
+    return base + suffix + ext
+
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _load_error
     if _lib is not None:
         return _lib
-    if _load_error is not None or not os.path.exists(_LIB_PATH):
+    if _load_error is not None:
+        return None
+    path = _so_path()
+    if path is None or not os.path.exists(path):
         return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(path)
         _bind_core(lib)
     except (OSError, AttributeError) as e:
         # a built .so that cannot load on THIS host (toolchain/libstdc++
         # mismatch — OSError) or that predates a core symbol
-        # (AttributeError from the prototype binding) is the same situation
-        # as an unbuilt one: fall back to the Python implementations
+        # (AttributeError from the prototype binding, including a stale
+        # build without hm_plan_abi_version) is the same situation as an
+        # unbuilt one: fall back to the Python implementations
         # (identical semantics), once, loudly
         _load_error = str(e)
         import warnings
 
-        warnings.warn(f"hivemall_tpu.native: {_LIB_PATH} failed to load "
+        warnings.warn(f"hivemall_tpu.native: {path} failed to load "
                       f"({e}); using Python fallbacks — rebuild with "
                       f"scripts/build_native.sh")
+        return None
+    # runtime half of the frozen-ABI contract (G025 is the static half):
+    # a .so compiled against a different plan layout must never serve
+    from ..ops.scatter import PLAN_ABI_VERSION
+
+    native_ver = int(lib.hm_plan_abi_version())
+    if native_ver != PLAN_ABI_VERSION:
+        _load_error = (f"plan ABI version mismatch: .so compiled with "
+                       f"{native_ver}, Python expects {PLAN_ABI_VERSION}")
+        import warnings
+
+        warnings.warn(f"hivemall_tpu.native: {path} failed to load "
+                      f"({_load_error}); using Python fallbacks — rebuild "
+                      f"with scripts/build_native.sh")
         return None
     _bind_optional(lib)
     _lib = lib
@@ -48,6 +100,10 @@ def _load() -> Optional[ctypes.CDLL]:
 
 
 def _bind_core(lib: ctypes.CDLL) -> None:
+    # the ABI handshake symbol: absent => stale pre-v16 build, and the
+    # AttributeError here routes through _load's loud-fallback path
+    lib.hm_plan_abi_version.restype = ctypes.c_int64
+    lib.hm_plan_abi_version.argtypes = []
     lib.hm_murmur3_x86_32.restype = ctypes.c_int32
     lib.hm_murmur3_x86_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                       ctypes.c_uint32]
@@ -91,8 +147,11 @@ def _bind_core(lib: ctypes.CDLL) -> None:
 
 def _bind_optional(lib: ctypes.CDLL) -> None:
     """Per-symbol guards: these entry points may be absent from older .so
-    builds without invalidating the core library."""
-    try:
+    builds without invalidating the core library. hasattr probes (not
+    try/except around the whole block) so every PRESENT symbol gets its
+    full prototype declared at load time — no call ever runs on ctypes'
+    guessed signature (graftcheck G024's contract)."""
+    if hasattr(lib, "hm_lattice_tokenize_bulk"):
         lib.hm_lattice_tokenize_bulk.restype = ctypes.c_int64
         lib.hm_lattice_tokenize_bulk.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
@@ -102,9 +161,7 @@ def _bind_optional(lib: ctypes.CDLL) -> None:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
-    except AttributeError:  # older .so without the tokenizer
-        pass
-    try:
+    if hasattr(lib, "hm_arow_reference_rowloop"):
         lib.hm_arow_reference_rowloop.restype = ctypes.c_int64
         lib.hm_arow_reference_rowloop.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -112,9 +169,7 @@ def _bind_optional(lib: ctypes.CDLL) -> None:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p,
         ]
-    except AttributeError:  # older .so without the anchor loop
-        pass
-    try:
+    if hasattr(lib, "hm_fm_reference_rowloop"):
         lib.hm_fm_reference_rowloop.restype = ctypes.c_int64
         lib.hm_fm_reference_rowloop.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
@@ -123,9 +178,7 @@ def _bind_optional(lib: ctypes.CDLL) -> None:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p,
         ]
-    except AttributeError:  # older .so without the FM anchor loop
-        pass
-    try:
+    if hasattr(lib, "hm_batch_apply_block"):
         lib.hm_batch_apply_block.restype = ctypes.c_int64
         lib.hm_batch_apply_block.argtypes = [
             ctypes.c_int32, ctypes.c_float, ctypes.c_float, ctypes.c_float,
@@ -139,16 +192,12 @@ def _bind_optional(lib: ctypes.CDLL) -> None:
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int32, ctypes.c_void_p,
         ]
-    except AttributeError:  # older .so without the batched apply
-        pass
-    try:
+    if hasattr(lib, "hm_parse_features_batch"):
         lib.hm_parse_features_batch.restype = ctypes.c_int64
         lib.hm_parse_features_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_void_p, ctypes.c_void_p,
         ]
-    except AttributeError:  # older .so without the parser
-        pass
 
 
 def available() -> bool:
@@ -589,6 +638,21 @@ def lattice_tokenize_bulk(cps: np.ndarray, classes: np.ndarray,
     lib = _load()
     if lib is None or not hasattr(lib, "hm_lattice_tokenize_bulk"):
         return None
+    # pin every caller-marshalled buffer to the ABI dtype + C order: the
+    # native pass reads these at fixed widths, so a strided or
+    # wrong-width array here is silent corruption, not an exception
+    cps = np.ascontiguousarray(cps, np.uint32)
+    classes = np.ascontiguousarray(classes, np.uint8)
+    text_offsets = np.ascontiguousarray(text_offsets, np.int64)
+    surf_buf = np.ascontiguousarray(surf_buf, np.uint32)
+    surf_offsets = np.ascontiguousarray(surf_offsets, np.int64)
+    entry_offsets = np.ascontiguousarray(entry_offsets, np.int64)
+    entry_pos = np.ascontiguousarray(entry_pos, np.int16)
+    entry_cost = np.ascontiguousarray(entry_cost, np.int32)
+    conn = np.ascontiguousarray(conn, np.int32)
+    unk_base = np.ascontiguousarray(unk_base, np.int32)
+    unk_per = np.ascontiguousarray(unk_per, np.int32)
+    unk_pos = np.ascontiguousarray(unk_pos, np.int16)
     n_texts = len(text_offsets) - 1
     total_chars = int(text_offsets[-1])
     out_start = np.empty(max(total_chars, 1), np.int32)

@@ -28,6 +28,18 @@
 
 extern "C" {
 
+// ------------------------------------------------------------- abi version
+// Must match PLAN_ABI_VERSION in hivemall_tpu/ops/scatter.py — bump both in
+// the same commit whenever the plan layout or any exported signature
+// changes. The Python loader calls hm_plan_abi_version() at load time and
+// refuses a stale .so; graftcheck G025 cross-checks the two literals (and
+// every hm_* signature) statically.
+enum { HM_PLAN_ABI_VERSION = 1 };
+
+int64_t hm_plan_abi_version(void) {
+    return HM_PLAN_ABI_VERSION;
+}
+
 // ---------------------------------------------------------------- murmur3
 
 static inline uint32_t rotl32(uint32_t x, int8_t r) {

@@ -85,6 +85,27 @@ done < <(
     git ls-files --others --exclude-standard -- 'hivemall_tpu/**/*.py' \
       'hivemall_tpu/*.py'
   } | sort -u)
+# a native/*.cpp edit is an ABI edit: pull the FFI-boundary modules into the
+# scan so G022-G026 (and the G025 cross-language check against the edited C
+# source) gate the change even though no .py file moved
+cpp_changed=$(
+  {
+    git diff --name-only HEAD -- 'native/*.cpp' 'native/*.h'
+    git ls-files --others --exclude-standard -- 'native/*.cpp' 'native/*.h'
+  } | sort -u)
+if [[ -n "$cpp_changed" ]]; then
+  echo "graftcheck: native C++ changed — scanning the FFI boundary modules"
+  for f in hivemall_tpu/native/__init__.py hivemall_tpu/core/native_batch.py \
+           hivemall_tpu/ops/scatter.py; do
+    present=0
+    for e in ${existing[@]+"${existing[@]}"}; do
+      [[ "$e" == "$f" ]] && present=1
+    done
+    if [[ $present -eq 0 && -f "$f" ]]; then
+      existing+=("$f")
+    fi
+  done
+fi
 if [[ ${#existing[@]} -eq 0 ]]; then
   echo "graftcheck: no changed python files under hivemall_tpu/"
   exit 0

@@ -115,4 +115,38 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --skew --smoke
 
-exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q "$@"
+# tier-1 gate 11: native sanitizer pass — the parity/refusal suites run
+# against the ASan+UBSan-instrumented .so (halt_on_error: any heap
+# overflow, use-after-free, or UB aborts the run). This is the dynamic
+# complement to graftcheck's G022-G026 static FFI rules, and the harness
+# the threaded native apply will reuse with --sanitize=thread. Skips with
+# a NAMED reason — never silently — when the toolchain lacks the
+# compiler or sanitizer runtime libraries.
+sanitize_skip=""
+if ! command -v g++ >/dev/null 2>&1; then
+  sanitize_skip="no g++ on PATH"
+else
+  libasan="$(g++ -print-file-name=libasan.so)"
+  libubsan="$(g++ -print-file-name=libubsan.so)"
+  # -print-file-name echoes the bare name back when the library is absent
+  if [[ "$libasan" != */* || "$libubsan" != */* ]]; then
+    sanitize_skip="toolchain lacks libasan/libubsan runtimes"
+  fi
+fi
+if [[ -n "$sanitize_skip" ]]; then
+  echo "native-sanitizer gate: SKIPPED ($sanitize_skip)"
+else
+  bash scripts/build_native.sh --if-stale --sanitize=address,undefined
+  env LD_PRELOAD="$libasan $libubsan" \
+    ASAN_OPTIONS=halt_on_error=1:detect_leaks=0 \
+    UBSAN_OPTIONS=halt_on_error=1:print_stacktrace=1 \
+    HIVEMALL_TPU_NATIVE_SANITIZE=asan \
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_native.py tests/test_native_batch.py -q
+  echo "native-sanitizer gate: PASSED (ASan+UBSan, halt_on_error)"
+fi
+
+# --durations=15 keeps per-test cost visible so drift toward the 1200 s
+# tier-1 budget is attributable per-PR (ROADMAP hygiene)
+exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python -m pytest tests/ -q --durations=15 "$@"

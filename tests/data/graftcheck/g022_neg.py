@@ -1,0 +1,72 @@
+"""G022 negative fixture: every pointer crossing the FFI is dominated by
+a dtype+contiguity proof — an explicit coercion, a fresh dtype-pinned
+constructor, the sanctioning validator, an all-validating helper, a
+runtime guard statement, an astype copy, and a frombuffer wrap."""
+
+import ctypes
+
+import numpy as np
+
+lib = ctypes.CDLL("libfixture.so")
+lib.hm_fx_scale.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+lib.hm_fx_scale.restype = None
+lib.hm_fx_digest.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+lib.hm_fx_digest.restype = None
+
+
+def plan_abi_arrays(plan):
+    """Local stand-in for the sanctioning validator (raises on drift)."""
+    return np.zeros(4, np.int64), np.zeros(4, np.float32)
+
+
+def _mk(n):
+    return np.zeros(n, np.float32)
+
+
+def scale_contig(vals):
+    rows = np.ascontiguousarray(vals, dtype=np.float32)
+    rc = lib.hm_fx_scale(rows.ctypes.data_as(ctypes.c_void_p), len(rows))
+    return rc
+
+
+def scale_fresh(n):
+    out = np.zeros(n, np.float32)
+    rc = lib.hm_fx_scale(out.ctypes.data_as(ctypes.c_void_p), n)
+    return rc
+
+
+def scale_plan(plan):
+    idx, val = plan_abi_arrays(plan)
+    rc = lib.hm_fx_scale(idx.ctypes.data_as(ctypes.c_void_p), len(idx))
+    rc += lib.hm_fx_scale(val.ctypes.data_as(ctypes.c_void_p), len(val))
+    return rc
+
+
+def scale_helper(n):
+    buf = _mk(n)
+    rc = lib.hm_fx_scale(buf.ctypes.data_as(ctypes.c_void_p), n)
+    return rc
+
+
+def scale_guarded(rows):
+    if rows.dtype != np.float32 or not rows.flags["C_CONTIGUOUS"]:
+        raise ValueError("bad buffer for hm_fx_scale")
+    rc = lib.hm_fx_scale(rows.ctypes.data_as(ctypes.c_void_p), len(rows))
+    return rc
+
+
+def scale_astype(vals):
+    rows = vals.astype(np.float32)
+    rc = lib.hm_fx_scale(rows.ctypes.data_as(ctypes.c_void_p), len(rows))
+    return rc
+
+
+def scale_frombuffer(raw):
+    data = np.frombuffer(raw, dtype=np.uint8)
+    rc = lib.hm_fx_scale(data.ctypes.data_as(ctypes.c_void_p), len(data))
+    return rc
+
+
+def digest_bytes(payload: bytes):
+    # bytes marshal through c_char_p by value, no raw pointer taken
+    lib.hm_fx_digest(payload, len(payload))

@@ -1,0 +1,35 @@
+"""G022 positive fixture: raw pointers crossing the FFI without a
+dominating dtype+C-contiguity validation — an unvalidated parameter, an
+np.asarray that pins dtype but not contiguity (machine-fixable), an
+ascontiguousarray that pins contiguity but not dtype, and an unproven
+dict-subscript buffer."""
+
+import ctypes
+
+import numpy as np
+
+lib = ctypes.CDLL("libfixture.so")
+lib.hm_fx_scale.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+lib.hm_fx_scale.restype = None
+
+
+def scale_param(rows):
+    rc = lib.hm_fx_scale(rows.ctypes.data_as(ctypes.c_void_p), len(rows))  # EXPECT: G022
+    return rc
+
+
+def scale_asarray(vals):
+    rows = np.asarray(vals, dtype=np.float32)
+    rc = lib.hm_fx_scale(rows.ctypes.data_as(ctypes.c_void_p), len(rows))  # EXPECT: G022
+    return rc
+
+
+def scale_no_dtype(vals):
+    rows = np.ascontiguousarray(vals)
+    rc = lib.hm_fx_scale(rows.ctypes.data_as(ctypes.c_void_p), len(rows))  # EXPECT: G022
+    return rc
+
+
+def scale_state(state):
+    rc = lib.hm_fx_scale(state["buf"].ctypes.data_as(ctypes.c_void_p), 4)  # EXPECT: G022
+    return rc

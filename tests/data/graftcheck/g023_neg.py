@@ -1,0 +1,43 @@
+"""G023 negative fixture: every pointer has an owner live across the
+call — named validated bindings, a dict-subscript with provenance, an
+inline validated coercion (the ctypes pointer keeps it alive), a named
+array's integer address, and ctypes-owned memory."""
+
+import ctypes
+
+import numpy as np
+
+lib = ctypes.CDLL("libfixture.so")
+lib.hm_fx_fill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+lib.hm_fx_fill.restype = None
+
+
+def fill_named(a, b):
+    tmp = np.ascontiguousarray(a + b, dtype=np.float32)
+    lib.hm_fx_fill(tmp.ctypes.data_as(ctypes.c_void_p), len(tmp))
+    return tmp
+
+
+def fill_state(state):
+    state["buf"] = np.zeros(8, np.float32)
+    lib.hm_fx_fill(state["buf"].ctypes.data_as(ctypes.c_void_p), 8)
+
+
+def fill_inline(v):
+    # the fresh coerced array is owned by the ctypes pointer for the
+    # duration of the call — the accepted inline idiom
+    lib.hm_fx_fill(
+        np.ascontiguousarray(v, dtype=np.float32).ctypes.data_as(
+            ctypes.c_void_p), len(v))
+
+
+def named_address(n):
+    arr = np.zeros(n, np.float32)
+    addr = arr.ctypes.data  # arr stays live in this frame
+    return arr, addr
+
+
+def fill_ctypes_buffer(payload: bytes):
+    buf = ctypes.create_string_buffer(payload)
+    lib.hm_fx_fill(ctypes.cast(buf, ctypes.c_void_p), len(payload))
+    return buf

@@ -1,0 +1,37 @@
+"""G023 positive fixture: borrowed buffers crossing the FFI — an
+expression temporary, a slice view, a transpose view, and a stored raw
+address of a helper-returned temporary."""
+
+import ctypes
+
+import numpy as np
+
+lib = ctypes.CDLL("libfixture.so")
+lib.hm_fx_fill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+lib.hm_fx_fill.restype = None
+
+
+def _mk():
+    return np.zeros(4, np.float32)
+
+
+def fill_temp(a, b):
+    lib.hm_fx_fill((a + b).ctypes.data_as(ctypes.c_void_p), len(a))  # EXPECT: G023
+
+
+def fill_slice(vals):
+    lib.hm_fx_fill(vals[1:].ctypes.data_as(ctypes.c_void_p), len(vals) - 1)  # EXPECT: G023
+
+
+def fill_transposed(mat):
+    lib.hm_fx_fill(mat.T.ctypes.data_as(ctypes.c_void_p), mat.size)  # EXPECT: G023
+
+
+def stash_temp_pointer(a, b):
+    p = (a + b).ctypes.data_as(ctypes.c_void_p)  # EXPECT: G023
+    return p
+
+
+def stash_temp_address():
+    addr = _mk().ctypes.data  # EXPECT: G023
+    return addr

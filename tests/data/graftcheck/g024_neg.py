@@ -1,0 +1,28 @@
+"""G024 negative fixture: every invoked symbol carries a full prototype
+declared at load time, and no native call runs under a lock."""
+
+import ctypes
+import threading
+
+import numpy as np
+
+lib = ctypes.CDLL("libfixture.so")
+lib.hm_fx_scale.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+lib.hm_fx_scale.restype = ctypes.c_int64
+lib.hm_fx_count.argtypes = [ctypes.c_int64]
+lib.hm_fx_count.restype = ctypes.c_int64
+
+_LOCK = threading.Lock()
+
+
+def scale(vals):
+    rows = np.ascontiguousarray(vals, dtype=np.float32)
+    rc = lib.hm_fx_scale(rows.ctypes.data_as(ctypes.c_void_p), len(rows))
+    return rc
+
+
+def count_then_record(results, n):
+    rc = lib.hm_fx_count(n)  # marshalled outside the lock
+    with _LOCK:
+        results.append(rc)
+    return rc

@@ -1,0 +1,34 @@
+"""G024 positive fixture: symbols invoked with half a prototype — a
+missing restype (machine-fixable), a missing argtypes — and a fully
+declared native call made while a serving-path lock is held."""
+# graftcheck: serving-module
+
+import ctypes
+import threading
+
+import numpy as np
+
+lib = ctypes.CDLL("libfixture.so")
+lib.hm_fx_scale.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+lib.hm_fx_count.restype = ctypes.c_int64
+lib.hm_fx_tick.argtypes = [ctypes.c_int64]
+lib.hm_fx_tick.restype = ctypes.c_int64
+
+_LOCK = threading.Lock()
+
+
+def scale(vals):
+    rows = np.ascontiguousarray(vals, dtype=np.float32)
+    rc = lib.hm_fx_scale(rows.ctypes.data_as(ctypes.c_void_p), len(rows))  # EXPECT: G024
+    return rc
+
+
+def count(n):
+    rc = lib.hm_fx_count(n)  # EXPECT: G024
+    return rc
+
+
+def tick_locked(n):
+    with _LOCK:
+        rc = lib.hm_fx_tick(n)  # EXPECT: G024
+    return rc

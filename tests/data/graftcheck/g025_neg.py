@@ -1,0 +1,19 @@
+"""G025 negative fixture: declarations matching the C side exactly, the
+correct plan ABI version, and a symbol unknown to the C source (skipped:
+absence is the loader's AttributeError, not silent drift)."""
+
+import ctypes
+
+lib = ctypes.CDLL("libhivemall_native.so")
+
+PLAN_ABI_VERSION = 1
+
+lib.hm_murmur3_x86_32.restype = ctypes.c_int32
+lib.hm_murmur3_x86_32.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.c_uint32]
+
+lib.hm_encode_records_bound.restype = ctypes.c_int64
+lib.hm_encode_records_bound.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+
+lib.hm_fx_unknown.restype = ctypes.c_int64
+lib.hm_fx_unknown.argtypes = [ctypes.c_void_p]

@@ -1,0 +1,19 @@
+"""G025 positive fixture: Python declarations drifted from the C side —
+a bumped plan ABI version, a dropped argument (arity), a narrowed
+restype, and a narrowed int argument. Declarations only: the drift is
+visible without any call site."""
+
+import ctypes
+
+lib = ctypes.CDLL("libhivemall_native.so")
+
+PLAN_ABI_VERSION = 99  # EXPECT: G025
+
+lib.hm_murmur3_x86_32.restype = ctypes.c_int32
+lib.hm_murmur3_x86_32.argtypes = [ctypes.c_char_p, ctypes.c_int64]  # EXPECT: G025
+
+lib.hm_encode_records_bound.restype = ctypes.c_int32  # EXPECT: G025
+lib.hm_encode_records_bound.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+
+lib.hm_zigzag_leb128_encode.restype = ctypes.c_int64
+lib.hm_zigzag_leb128_encode.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p, ctypes.c_int64]  # EXPECT: G025

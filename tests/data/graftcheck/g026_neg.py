@@ -1,0 +1,34 @@
+"""G026 negative fixture: every status code is consumed — checked,
+returned, wrapped, or the export is genuinely void (restype None)."""
+
+import ctypes
+
+import numpy as np
+
+lib = ctypes.CDLL("libfixture.so")
+lib.hm_fx_fill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+lib.hm_fx_fill.restype = ctypes.c_int64
+lib.hm_fx_count.argtypes = [ctypes.c_int64]
+lib.hm_fx_count.restype = ctypes.c_int64
+lib.hm_fx_note.argtypes = [ctypes.c_int64]
+lib.hm_fx_note.restype = None
+
+
+def fill(n):
+    out = np.zeros(n, np.float32)
+    rc = lib.hm_fx_fill(out.ctypes.data_as(ctypes.c_void_p), n)
+    if rc < 0:
+        raise ValueError("native fill refused")
+    return out
+
+
+def count(n):
+    return lib.hm_fx_count(n)
+
+
+def count_as_int(n):
+    return int(lib.hm_fx_count(n))
+
+
+def note(n):
+    lib.hm_fx_note(n)  # void export: nothing to check

@@ -1,0 +1,22 @@
+"""G026 positive fixture: native status codes dropped on the floor — a
+bare statement call and an assignment to underscore."""
+
+import ctypes
+
+import numpy as np
+
+lib = ctypes.CDLL("libfixture.so")
+lib.hm_fx_fill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+lib.hm_fx_fill.restype = ctypes.c_int64
+lib.hm_fx_count.argtypes = [ctypes.c_int64]
+lib.hm_fx_count.restype = ctypes.c_int64
+
+
+def fill(n):
+    out = np.zeros(n, np.float32)
+    lib.hm_fx_fill(out.ctypes.data_as(ctypes.c_void_p), n)  # EXPECT: G026
+    return out
+
+
+def count_discard(n):
+    _ = lib.hm_fx_count(n)  # EXPECT: G026

@@ -71,7 +71,8 @@ def _cli(*argv, cwd=REPO):
 RULES = ["g001", "g002", "g003", "g004", "g005", "g006",
          "g007", "g008", "g009", "g010", "g011",
          "g012", "g013", "g014", "g015", "g016",
-         "g017", "g018", "g019", "g020", "g021"]
+         "g017", "g018", "g019", "g020", "g021",
+         "g022", "g023", "g024", "g025", "g026"]
 
 # the four hot-path modules the acceptance criteria pin at zero G001/G002
 HOT_MODULES = [
@@ -609,3 +610,122 @@ def test_g003_pin_preserves_weak_literal_numerics():
     pb = jnp.asarray([0.5], jnp.bfloat16)
     yb = jnp.asarray([1.0], jnp.bfloat16)
     assert losses.SquaredHingeLoss.loss(pb, yb).dtype == jnp.bfloat16
+
+
+def test_fixer_round_trip_g022_ascontiguousarray(tmp_path):
+    """--fix on the G022 positive fixture upgrades the dtype-pinned
+    np.asarray defining assignment to np.ascontiguousarray; the other
+    cases (bare parameter, no-dtype coercion, dict subscript) keep their
+    fix-less findings, and a second run is a no-op."""
+    import shutil
+
+    target = tmp_path / "g022_case.py"
+    shutil.copy(os.path.join(DATA, "g022_pos.py"), target)
+    proc = _cli(str(target), "--fix", "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = target.read_text()
+    assert "np.ascontiguousarray(vals, dtype=np.float32)" in fixed
+    assert "np.asarray(vals, dtype=np.float32)" not in fixed
+    remaining = [f for f in analyze_paths([str(target)])
+                 if f.rule == "G022"]
+    assert len(remaining) == 3, [f.format() for f in remaining]
+    assert all(f.fix is None for f in remaining)
+    proc2 = _cli(str(target), "--fix", "--no-baseline")
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "no applicable fixes" in proc2.stdout
+    assert target.read_text() == fixed
+    proc3 = _cli(str(target), "--fix-check", "--no-baseline")
+    assert proc3.returncode == 0, "fix-check must be idempotent post-fix"
+
+
+def test_fixer_round_trip_g024_restype(tmp_path):
+    """--fix on the G024 positive fixture splices a restype declaration
+    onto the argtypes line of the restype-less symbol; the argtypes-less
+    symbol and the under-lock call keep their fix-less findings."""
+    import shutil
+
+    target = tmp_path / "g024_case.py"
+    shutil.copy(os.path.join(DATA, "g024_pos.py"), target)
+    proc = _cli(str(target), "--fix", "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = target.read_text()
+    assert ("lib.hm_fx_scale.restype = ctypes.c_int64; "
+            "lib.hm_fx_scale.argtypes") in fixed
+    remaining = [f for f in analyze_paths([str(target)])
+                 if f.rule == "G024"]
+    # hm_fx_count still lacks argtypes; hm_fx_tick still runs under lock
+    assert len(remaining) == 2, [f.format() for f in remaining]
+    assert all(f.fix is None for f in remaining)
+    proc2 = _cli(str(target), "--fix", "--no-baseline")
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "no applicable fixes" in proc2.stdout
+    assert target.read_text() == fixed
+
+
+def test_g025_sarif_carries_both_file_locations():
+    """G025 results must annotate BOTH sides of the drift: the Python
+    declaration (primary location) and the C declaration it disagrees
+    with (second physicalLocation into native/hivemall_native.cpp)."""
+    proc = _cli(os.path.join(DATA, "g025_pos.py"), "--no-baseline",
+                "--format", "sarif")
+    assert proc.returncode == 1  # findings present
+    doc = json.loads(proc.stdout)
+    results = [r for r in doc["runs"][0]["results"]
+               if r["ruleId"] == "G025"]
+    assert results, "G025 findings expected in SARIF"
+    for r in results:
+        uris = [loc["physicalLocation"]["artifactLocation"]["uri"]
+                for loc in r["locations"]]
+        assert uris[0].endswith("g025_pos.py"), uris
+        if "PLAN_ABI_VERSION" in r["message"]["text"] \
+                or "hm_" in r["message"]["text"]:
+            assert any(u.endswith("native/hivemall_native.cpp")
+                       for u in uris[1:]), (
+                f"missing C++ location in {uris}")
+        for loc in r["locations"]:
+            assert loc["physicalLocation"]["region"]["startLine"] >= 1
+
+
+def test_g025_seeded_abi_drift_end_to_end(tmp_path, monkeypatch):
+    """Bump HM_PLAN_ABI_VERSION in a tempdir copy of the C source and
+    point the scanner at it: G025 must fire on the real ops/scatter.py
+    declaration of PLAN_ABI_VERSION; against the real C source the same
+    scan is clean."""
+    src = os.path.join(REPO, "native", "hivemall_native.cpp")
+    with open(src, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    assert "HM_PLAN_ABI_VERSION = 1" in text
+    drifted = tmp_path / "hivemall_native.cpp"
+    drifted.write_text(text.replace("HM_PLAN_ABI_VERSION = 1",
+                                    "HM_PLAN_ABI_VERSION = 2"))
+    scatter = os.path.join("hivemall_tpu", "ops", "scatter.py")
+
+    monkeypatch.setenv("GRAFTCHECK_NATIVE_CPP", str(drifted))
+    findings = [f for f in analyze_paths([os.path.join(REPO, scatter)])
+                if f.rule == "G025"]
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert "PLAN_ABI_VERSION = 1" in findings[0].snippet
+    assert "HM_PLAN_ABI_VERSION = 2" in findings[0].message
+    assert findings[0].related, "drift finding must carry the C location"
+
+    monkeypatch.delenv("GRAFTCHECK_NATIVE_CPP")
+    clean = [f for f in analyze_paths([os.path.join(REPO, scatter)])
+             if f.rule == "G025"]
+    assert clean == [], [f.format() for f in clean]
+
+
+def test_ffi_rules_clean_on_shipped_bindings():
+    """The shipped FFI boundary — bindings, native batch staging, plan
+    ABI — must be G022-G026 clean with zero baseline entries for the new
+    rules: real findings get FIXED, not baselined (ISSUE 16 acceptance)."""
+    boundary = [os.path.join(REPO, p) for p in (
+        "hivemall_tpu/native/__init__.py",
+        "hivemall_tpu/core/native_batch.py",
+        "hivemall_tpu/ops/scatter.py",
+    )]
+    ffi_rules = {"G022", "G023", "G024", "G025", "G026"}
+    found = [f for f in analyze_paths(boundary) if f.rule in ffi_rules]
+    assert found == [], "\n".join(f.format() for f in found)
+    baseline = load_baseline(DEFAULT_BASELINE)
+    assert not any(b.rule in ffi_rules for b in baseline), (
+        "FFI findings must be fixed, never baselined")

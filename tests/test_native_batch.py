@@ -280,6 +280,9 @@ def test_unloadable_so_is_reported_not_swallowed(tmp_path, monkeypatch):
 
     bad = tmp_path / "libhivemall_native.so"
     bad.write_bytes(b"\x7fELFnot-actually-an-elf")
+    # pin the plain variant: under the sanitizer gate the env var would
+    # redirect the loader to a (nonexistent) .asan.so and skip the warning
+    monkeypatch.setenv("HIVEMALL_TPU_NATIVE_SANITIZE", "")
     monkeypatch.setattr(nat, "_LIB_PATH", str(bad))
     monkeypatch.setattr(nat, "_lib", None)
     monkeypatch.setattr(nat, "_load_error", None)
