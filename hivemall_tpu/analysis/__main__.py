@@ -84,8 +84,11 @@ def main(argv: List[str] = None) -> int:
                     "G012-G016, and dtype/precision flow G017-G021 — "
                     "silent hot-path promotion, f64 serving leaks, "
                     "cast-in-loop dequant, artifact dtype round-trips, "
-                    "low-precision accumulation — with a --fix autofix "
-                    "engine and SARIF output)")
+                    "low-precision accumulation — FFI boundary safety "
+                    "G022-G026, and exception-flow / failure-path safety "
+                    "G027-G031: future leaks, silent fallbacks, swallowed "
+                    "exceptions, unwind-unsafe locking, unbounded retries "
+                    "— with a --fix autofix engine and SARIF output)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: hivemall_tpu)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
@@ -117,6 +120,10 @@ def main(argv: List[str] = None) -> int:
                     help="also scan package modules that (transitively) "
                          "import the given paths — interprocedural rules "
                          "can fire in an unchanged caller")
+    ap.add_argument("--jobs", type=int, default=None, metavar="N",
+                    help="thread-pool width for per-file module rules "
+                         "(default min(4, cpus); 1 forces serial); "
+                         "finding order is deterministic either way")
     args = ap.parse_args(argv)
 
     if args.output is not None:
@@ -151,7 +158,7 @@ def main(argv: List[str] = None) -> int:
     if args.with_callers:
         from .runner import expand_to_callers
         paths = expand_to_callers(paths)
-    findings = analyze_paths(paths, rules=rules)
+    findings = analyze_paths(paths, rules=rules, jobs=args.jobs)
 
     if args.fix or args.fix_check:
         # fix only what the baseline gate would report: baseline-accepted

@@ -173,18 +173,36 @@ class ConcurrencyModel:
         # G013 for module-level functions holding local/module locks
         self.fn_calls: List[Tuple[str, str, CallEv]] = []
         self.lock_edges: List[LockEdge] = []
+        self._ctor_memo: Dict[Tuple[str, str, str], Optional[str]] = {}
         for path in sorted(program.modules):
-            self._build_module(path)
-        for cls in self.classes.values():
-            self._propagate(cls)
+            model = program.modules.get(path)
+            if model is None:
+                continue
+            # per-module collection + per-class propagation are pure
+            # per-module products — cache them on the ModuleModel, whose
+            # lifetime (modelcache's mtime layer) already tracks file changes,
+            # so repeated in-process scans (the test suite's _cli runs)
+            # pay the walkers once per module version. Only the
+            # cross-class lock-ordering edges rebuild per program.
+            cached = getattr(model, "_graftcheck_conc", None)
+            if cached is None:
+                mod_classes: Dict[ClassKey, ClassConc] = {}
+                mod_calls: List[Tuple[str, str, CallEv]] = []
+                self._build_module(path, model, mod_classes, mod_calls)
+                for cls in mod_classes.values():
+                    self._propagate(cls)
+                cached = (mod_classes, mod_calls)
+                model._graftcheck_conc = cached  # type: ignore[attr-defined]
+            for key, cls in cached[0].items():
+                self.classes.setdefault(key, cls)
+            self.fn_calls.extend(cached[1])
         self._build_edges()
 
     # -- construction ------------------------------------------------------
 
-    def _build_module(self, path: str) -> None:
-        model = self.program.modules.get(path)
-        if model is None:
-            return
+    def _build_module(self, path: str, model: ModuleModel,
+                      out_classes: Dict[ClassKey, ClassConc],
+                      out_calls: List[Tuple[str, str, CallEv]]) -> None:
         # cheap pre-filter: nothing lock/thread-shaped, nothing to model
         src = model.source
         if "Lock" not in src and "Condition" not in src \
@@ -212,22 +230,24 @@ class ConcurrencyModel:
                 cls.raw[mname] = self._collect(cls, mname, m, model,
                                                module_locks)
             self._close_thread_side(cls)
-            self.classes.setdefault((path, cls.name), cls)
+            out_classes.setdefault((path, cls.name), cls)
         # module-level and nested (non-method) defs: call events only
         for fn in model.functions:
             parent = getattr(fn, "graftcheck_parent", None)
             if isinstance(parent, ast.ClassDef):
                 continue  # direct method, covered above
-            owner = self._owning_class(fn, path)
+            owner = self._owning_class(fn, path, out_classes)
             ev = self._collect(owner, fn.name, fn, model, module_locks)
             for call in ev.calls:
-                self.fn_calls.append((path, fn.name, call))
+                out_calls.append((path, fn.name, call))
 
-    def _owning_class(self, fn: ast.AST, path: str) -> Optional[ClassConc]:
+    def _owning_class(self, fn: ast.AST, path: str,
+                      classes: Dict[ClassKey, ClassConc]
+                      ) -> Optional[ClassConc]:
         cur = getattr(fn, "graftcheck_parent", None)
         while cur is not None:
             if isinstance(cur, ast.ClassDef):
-                return self.classes.get((path, cur.name))
+                return classes.get((path, cur.name))
             cur = getattr(cur, "graftcheck_parent", None)
         return None
 
@@ -528,6 +548,15 @@ class ConcurrencyModel:
         return target_cls, method
 
     def _self_field_ctor(self, cls: ClassConc, field: str) -> Optional[str]:
+        key = (cls.path, cls.name, field)
+        if key in self._ctor_memo:
+            return self._ctor_memo[key]
+        got = self._self_field_ctor_uncached(cls, field)
+        self._ctor_memo[key] = got
+        return got
+
+    def _self_field_ctor_uncached(self, cls: ClassConc,
+                                  field: str) -> Optional[str]:
         methods = sorted(cls.methods.values(),
                          key=lambda m: m.name != "__init__")
         for m in methods:

@@ -209,6 +209,39 @@ FFI_ABI_VERSION_CONSTANT = "PLAN_ABI_VERSION"
 FFI_NATIVE_CPP_ENV = "GRAFTCHECK_NATIVE_CPP"
 FFI_NATIVE_CPP_DEFAULT = "native/hivemall_native.cpp"
 
+# --- G027-G031: exception flow / failure paths (v6) --------------------------
+# Failure-path scope: the serving request path, the continuous-training
+# pipeline, and the whole runtime package (recovery driver, fault injector,
+# tracing, metrics, cluster shims) — the code whose exception paths the
+# reliability fronts depend on. A Future leaked on an unwind here hangs a
+# client forever; a silent fallback hides a degradation until a bench
+# regresses. Modules outside the prefixes opt in with the marker comment.
+EXCEPTION_HOT_PREFIXES = (
+    "hivemall_tpu/serving/",
+    "hivemall_tpu/pipeline/",
+    "hivemall_tpu/runtime/",
+)
+EXCEPTION_MARKER = "# graftcheck: failure-path-module"
+
+# Handler calls that count as a LOUD surface for G028: the fallback names
+# its reason somewhere an operator can see (warnings / logging / the trace
+# ring / the metrics registry).
+LOUD_CALL_TAILS = ("warn", "warning", "warn_explicit", "error", "exception",
+                   "critical", "fatal", "instant", "increment")
+LOUD_CALL_ROOTS = ("warnings", "logging")
+
+# Handler types whose silent fallback is the sanctioned API-probing idiom
+# (compat shims, optional native libraries) — a handler catching ONLY these
+# is never a G028 degraded path.
+PROBE_EXCEPTION_TYPES = frozenset({
+    "ImportError", "ModuleNotFoundError", "AttributeError",
+})
+
+# Retry backoff classification for G031 (tails of dotted callees).
+# cv.wait(timeout) counts: blocking on a condition variable IS the
+# well-behaved form of waiting between attempts.
+BACKOFF_CALL_TAILS = ("sleep", "wait")
+
 # --- G005: donation --------------------------------------------------------
 # jit-wrapped functions whose name looks step-shaped should donate their
 # model-state argument; otherwise every hot-loop step copies the tables.

@@ -427,48 +427,62 @@ def get_ffi(program: ProgramModel) -> FFIModel:
         return cached
     ffi = FFIModel()
     for path, model in program.modules.items():
-        if "ctypes" not in model.source:
+        # the ModuleFFI is a pure per-module product: cache it on the
+        # ModuleModel (False = scanned, nothing foreign) so repeated
+        # in-process scans skip the AST walk entirely
+        mod = getattr(model, "_graftcheck_ffi_mod", None)
+        if mod is not None:
+            if mod is not False:
+                ffi.modules[path] = mod
             continue
-        mod = ModuleFFI()
-        for node in ast.walk(model.tree):
-            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                    and isinstance(node.targets[0], ast.Name) \
-                    and _is_asp_lambda(node.value):
-                mod.asp_names.add((model.enclosing_function(node),
-                                   node.targets[0].id))
-            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                    and isinstance(node.targets[0], ast.Attribute):
-                tgt = node.targets[0]
-                if tgt.attr not in ("argtypes", "restype"):
-                    continue
-                sym = None
-                if isinstance(tgt.value, ast.Attribute):
-                    if tgt.value.attr.startswith(
-                            tuple(config.FFI_SYMBOL_PREFIXES)):
-                        sym = tgt.value.attr
-                if sym is None:
-                    continue
-                decl = mod.decls.setdefault(sym, PyDecl(sym))
-                src = ast.get_source_segment(model.source, tgt) or ""
-                if tgt.attr == "argtypes":
-                    decl.argtypes_node = node
-                    decl.argtypes_line = node.lineno
-                    decl.argtypes_src = src
-                    decl.argtypes_kinds = _eval_argtypes(node.value)
-                else:
-                    decl.restype_node = node
-                    decl.restype_line = node.lineno
-                    decl.restype_src = src
-                    decl.restype_kind = _restype_kind(node.value)
-            elif isinstance(node, ast.Call):
-                sym = foreign_symbol(dotted_name(node.func))
-                if sym is not None:
-                    mod.calls.append(ForeignCall(
-                        node, sym, model.enclosing_function(node)))
-        if mod.decls or mod.calls:
+        mod = _build_module_ffi(model)
+        model._graftcheck_ffi_mod = mod if mod is not None else False  # type: ignore[attr-defined]
+        if mod is not None:
             ffi.modules[path] = mod
     program._graftcheck_ffi = ffi  # type: ignore[attr-defined]
     return ffi
+
+
+def _build_module_ffi(model) -> Optional[ModuleFFI]:
+    if "ctypes" not in model.source:
+        return None
+    mod = ModuleFFI()
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _is_asp_lambda(node.value):
+            mod.asp_names.add((model.enclosing_function(node),
+                               node.targets[0].id))
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Attribute):
+            tgt = node.targets[0]
+            if tgt.attr not in ("argtypes", "restype"):
+                continue
+            sym = None
+            if isinstance(tgt.value, ast.Attribute):
+                if tgt.value.attr.startswith(
+                        tuple(config.FFI_SYMBOL_PREFIXES)):
+                    sym = tgt.value.attr
+            if sym is None:
+                continue
+            decl = mod.decls.setdefault(sym, PyDecl(sym))
+            src = ast.get_source_segment(model.source, tgt) or ""
+            if tgt.attr == "argtypes":
+                decl.argtypes_node = node
+                decl.argtypes_line = node.lineno
+                decl.argtypes_src = src
+                decl.argtypes_kinds = _eval_argtypes(node.value)
+            else:
+                decl.restype_node = node
+                decl.restype_line = node.lineno
+                decl.restype_src = src
+                decl.restype_kind = _restype_kind(node.value)
+        elif isinstance(node, ast.Call):
+            sym = foreign_symbol(dotted_name(node.func))
+            if sym is not None:
+                mod.calls.append(ForeignCall(
+                    node, sym, model.enclosing_function(node)))
+    return mod if (mod.decls or mod.calls) else None
 
 
 # --------------------------------------------------------------------------

@@ -31,12 +31,27 @@ class Edit:
 
 
 @dataclass(frozen=True)
+class WrapFinally:
+    """A multi-line repair for G030: indent lines `start`..`release_line-1`
+    one level under an inserted ``try:`` and turn the release statement at
+    `release_line` into ``finally:`` + the indented release. `release_text`
+    is the stripped source of the release line at plan time — the fixer
+    re-validates it so a stale plan never rewrites changed code."""
+    start: int  # 1-based first line of the wrapped region
+    release_line: int  # 1-based line of the X.release() statement
+    release_text: str
+
+
+@dataclass(frozen=True)
 class Fix:
     """A machine-applicable repair attached to a finding. `add_import` is
     (module, name) — the fixer merges all requested names per module into
-    one import statement and inserts/extends it idempotently."""
+    one import statement and inserts/extends it idempotently. `wrap` is a
+    try/finally wrap; wraps shift line numbers, so the fixer applies them
+    after every within-line edit, bottom-up."""
     edits: Tuple[Edit, ...] = ()
     add_import: Optional[Tuple[str, str]] = None
+    wrap: Optional[WrapFinally] = None
 
 
 @dataclass(frozen=True)

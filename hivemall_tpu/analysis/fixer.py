@@ -8,6 +8,10 @@ buys two properties the rules rely on:
   every fix collected in a single scan applies against the same line
   numbering; import insertion (which does add a line) runs last, per
   file, against the already-edited source;
+- **ordered multi-line wraps**: the G030 try/finally wrap DOES insert
+  lines, so wraps apply after every within-line edit, bottom-up by
+  start line (lower wraps first never shift an upper wrap's numbering),
+  each re-validated against the release line's current text;
 - **idempotence**: an applied fix removes its own finding, so a second
   ``--fix`` run collects no edits and writes nothing — the property
   ``scripts/lint.sh --fix-check`` (and the round-trip test) locks in.
@@ -116,6 +120,34 @@ def _ensure_imports(source: str, wanted: Dict[str, Set[str]]) -> str:
     return "".join(lines)
 
 
+def _apply_wraps(lines: List[str], wraps, path: str, notes: List[str],
+                 rules: Dict[int, str]) -> bool:
+    """Apply WrapFinally repairs bottom-up (highest start first), so an
+    applied wrap's inserted lines never shift a pending wrap above it."""
+    applied = False
+    for w in sorted(wraps, key=lambda w: -w.start):
+        if not (1 <= w.start <= w.release_line <= len(lines)):
+            notes.append(f"{path}:{w.start}: wrap for "
+                         f"{rules.get(w.start, '?')} skipped — lines out "
+                         f"of range (stale finding?)")
+            continue
+        release_raw = lines[w.release_line - 1]
+        if release_raw.strip() != w.release_text:
+            notes.append(
+                f"{path}:{w.release_line}: wrap skipped — expected "
+                f"release {w.release_text!r}, found "
+                f"{release_raw.strip()!r} (stale finding?)")
+            continue
+        indent = release_raw[:len(release_raw) - len(release_raw.lstrip())]
+        body = [("    " + ln if ln.strip() else ln)
+                for ln in lines[w.start - 1:w.release_line - 1]]
+        lines[w.start - 1:w.release_line] = (
+            [indent + "try:\n"] + body +
+            [indent + "finally:\n", indent + "    " + w.release_text + "\n"])
+        applied = True
+    return applied
+
+
 def plan_fixes(findings: Sequence[Finding], root: str = "."
                ) -> Tuple[Result, List[str]]:
     """Compute the post-fix sources for every file a fixable finding
@@ -138,6 +170,8 @@ def plan_fixes(findings: Sequence[Finding], root: str = "."
             continue
         lines = old_source.splitlines(keepends=True)
         wanted_imports: Dict[str, Set[str]] = {}
+        wraps = []
+        wrap_rules: Dict[int, str] = {}
         applied_any = False
         for f in flist:
             ok = True
@@ -158,6 +192,12 @@ def plan_fixes(findings: Sequence[Finding], root: str = "."
             if f.fix.add_import is not None:
                 module, name = f.fix.add_import
                 wanted_imports.setdefault(module, set()).add(name)
+            if f.fix.wrap is not None:
+                wraps.append(f.fix.wrap)
+                wrap_rules[f.fix.wrap.start] = f.rule
+            applied_any = bool(f.fix.edits) or f.fix.add_import is not None \
+                or applied_any
+        if _apply_wraps(lines, wraps, path, notes, wrap_rules):
             applied_any = True
         if not applied_any:
             continue

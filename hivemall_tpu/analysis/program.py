@@ -53,8 +53,6 @@ StrVal = Tuple[str, str]
 # package-tree context cache
 # --------------------------------------------------------------------------
 
-_PKG_CACHE: Dict[str, Tuple[float, int, Optional[ModuleModel]]] = {}
-
 
 def package_root() -> str:
     """Filesystem path of the hivemall_tpu package this analyzer lives in."""
@@ -62,10 +60,12 @@ def package_root() -> str:
 
 
 def _load_package_models() -> Dict[str, ModuleModel]:
-    """Parse (or reuse from the mtime cache) every module of the package.
+    """Every module of the package, through the shared model cache
+    (modelcache.py: in-process mtime layer + on-disk sha256 layer).
     Returns {normalized rel_path: ModuleModel}; unparsable files are
     skipped here — the runner reports them when they are in the scanned
     set."""
+    from . import modelcache
     root = package_root()
     out: Dict[str, ModuleModel] = {}
     prefix = os.path.basename(root)  # "hivemall_tpu"
@@ -77,25 +77,10 @@ def _load_package_models() -> Dict[str, ModuleModel]:
             ap = os.path.join(dirpath, name)
             rel = prefix + "/" + os.path.relpath(ap, root).replace(
                 os.sep, "/")
-            try:
-                st = os.stat(ap)
-            except OSError:
-                continue
-            cached = _PKG_CACHE.get(ap)
-            if cached is not None and cached[0] == st.st_mtime \
-                    and cached[1] == st.st_size:
-                model = cached[2]
-            else:
-                try:
-                    with open(ap, "r", encoding="utf-8") as fh:
-                        source = fh.read()
-                    model = ModuleModel(rel, source,
-                                        ast.parse(source, filename=rel))
-                except (OSError, SyntaxError):
-                    model = None
-                _PKG_CACHE[ap] = (st.st_mtime, st.st_size, model)
+            model = modelcache.cached_model(ap, rel)
             if model is not None:
                 out[rel] = model
+    modelcache.save()
     return out
 
 

@@ -19,7 +19,10 @@ from . import (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
                g019_cast_in_loop, g020_artifact_dtype,
                g021_low_precision_accum, g022_ffi_unvalidated_pointer,
                g023_ffi_borrowed_buffer, g024_ffi_missing_prototype,
-               g025_ffi_abi_drift, g026_ffi_unchecked_return)
+               g025_ffi_abi_drift, g026_ffi_unchecked_return,
+               g027_future_leak, g028_silent_fallback,
+               g029_swallowed_exception, g030_unwind_under_lock,
+               g031_unbounded_retry)
 
 _MODULE_RULES = (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
                  g005_donation, g006_side_effect, g009_api_compat,
@@ -32,7 +35,9 @@ _PROGRAM_RULES = (g007_collective_axis, g008_spec_mesh,
                   g020_artifact_dtype, g021_low_precision_accum,
                   g022_ffi_unvalidated_pointer, g023_ffi_borrowed_buffer,
                   g024_ffi_missing_prototype, g025_ffi_abi_drift,
-                  g026_ffi_unchecked_return)
+                  g026_ffi_unchecked_return, g027_future_leak,
+                  g028_silent_fallback, g029_swallowed_exception,
+                  g030_unwind_under_lock, g031_unbounded_retry)
 
 ALL_RULES: Dict[str, Callable[[ModuleModel], List[Finding]]] = {
     m.RULE_ID: m.check for m in _MODULE_RULES

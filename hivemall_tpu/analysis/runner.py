@@ -55,20 +55,48 @@ def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
             yield p
 
 
+def default_jobs() -> int:
+    return min(4, os.cpu_count() or 1)
+
+
 def _run_rules(models: Dict[str, ModuleModel],
                parse_failures: List[Finding],
                sources: Dict[str, str],
-               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+               rules: Optional[Sequence[str]] = None,
+               jobs: Optional[int] = None) -> List[Finding]:
     """Module rules per model + program rules over the whole set, then
-    per-file suppressions."""
+    per-file suppressions.
+
+    Module rules are independent per file, so with ``jobs > 1`` they run
+    on a thread pool (default ``min(4, cpus)``). Results are collected
+    per file in the submission order and the whole set goes through
+    ``sort_findings`` at the end, so finding order — and therefore
+    baseline and SARIF fingerprint stability — is identical to a serial
+    run. Program rules share one mutable ProgramModel (memoized
+    summaries, lazily-built concurrency/exception models) and stay
+    serial."""
     from .rules import ALL_RULES, PROGRAM_RULES
 
+    selected_module_rules = [
+        (rule_id, check) for rule_id, check in ALL_RULES.items()
+        if rules is None or rule_id in rules]
+
+    def module_findings(model: ModuleModel) -> List[Finding]:
+        out: List[Finding] = []
+        for _rule_id, check in selected_module_rules:
+            out.extend(check(model))
+        return out
+
     findings: List[Finding] = list(parse_failures)
-    for rel_path, model in models.items():
-        for rule_id, check in ALL_RULES.items():
-            if rules is not None and rule_id not in rules:
-                continue
-            findings.extend(check(model))
+    jobs = default_jobs() if jobs is None else max(1, jobs)
+    if jobs > 1 and len(models) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            for per_file in pool.map(module_findings, models.values()):
+                findings.extend(per_file)
+    else:
+        for model in models.values():
+            findings.extend(module_findings(model))
     selected_program_rules = [
         (rule_id, check_program)
         for rule_id, check_program in PROGRAM_RULES.items()
@@ -108,12 +136,23 @@ def analyze_source(source: str, rel_path: str,
 
 
 def analyze_paths(paths: Sequence[str],
-                  rules: Optional[Sequence[str]] = None) -> List[Finding]:
+                  rules: Optional[Sequence[str]] = None,
+                  jobs: Optional[int] = None) -> List[Finding]:
+    from . import modelcache
     models: Dict[str, ModuleModel] = {}
     sources: Dict[str, str] = {}
     parse_failures: List[Finding] = []
     for path in iter_python_files(paths):
         rel = normalize_path(path)
+        model = modelcache.cached_model(path, rel)
+        if model is not None:
+            # shared cache hit/build: package-context and scanned models
+            # are the SAME objects, so per-module analysis memos persist
+            # across scans instead of being rebuilt per analyze_paths call
+            models[rel] = model
+            sources[rel] = model.source
+            continue
+        # unreadable or unparsable: re-read for the precise G000 message
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 source = fh.read()
@@ -129,7 +168,9 @@ def analyze_paths(paths: Sequence[str],
             parse_failures.append(Finding(rel, e.lineno or 0, "G000",
                                           Severity.ERROR,
                                           f"syntax error: {e.msg}", ""))
-    return _run_rules(models, parse_failures, sources, rules)
+    findings = _run_rules(models, parse_failures, sources, rules, jobs)
+    modelcache.save()
+    return findings
 
 
 def expand_to_callers(paths: Sequence[str]) -> List[str]:

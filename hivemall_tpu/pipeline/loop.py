@@ -127,6 +127,10 @@ class PipelineConfig:
     amplify_x: int = 1  # ftvec/amplify multi-epoch substitute
     amplify_buffers: int = 4
     max_restarts: int = 8
+    # linear backoff between recoverable restarts (sleep = backoff * n,
+    # capped at 1 s): a persistently failing step must not spin the
+    # restart path at CPU speed (graftcheck G031)
+    restart_backoff_s: float = 0.02
     checkpoint_path: Optional[str] = None
     # the gate's candidate engines (scoring only, never deployed)
     gate_engine_kwargs: dict = dc_field(
@@ -304,6 +308,8 @@ class ContinuousPipeline:
                                        args={"cause": type(e).__name__})
                         if restarts > self.cfg.max_restarts:
                             raise
+                        time.sleep(min(
+                            self.cfg.restart_backoff_s * restarts, 1.0))
         finally:
             with self._lock:
                 self._stats["running"] = False

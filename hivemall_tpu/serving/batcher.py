@@ -302,6 +302,17 @@ class DynamicBatcher:
                         p.future.add_done_callback(
                             lambda f, s=span: TRACER.end(s))
                     return p.future  # the cache settles it with the leaders
+                if plan.kind == "refused":
+                    # a row of this request was quota-refused within the
+                    # negative TTL: repeat the refusal synchronously from
+                    # the cache front — no admission lock, no shed scan.
+                    # The owned span is abandoned un-ended on purpose,
+                    # like every rejected submit (503s don't fill the
+                    # ring).
+                    if span.recording:
+                        span.event("cache.negative",
+                                   version=self._cache_version)
+                    raise plan.error
                 token = plan.token
         evicted: List[_Pending] = []
         err: Optional[Exception] = None
@@ -361,6 +372,13 @@ class DynamicBatcher:
             # lead() below, only on success), so no follower can be
             # stranded on an admission error — the refusal stays
             # synchronous, where registry.submit's swap-retry can see it
+            if token is not None and isinstance(err, QueueFull):
+                # quota refusal of a lead request: its new keys enter the
+                # short-TTL negative cache, so the hot row stops
+                # re-entering admission until capacity can have recovered
+                # (a closed batcher is NOT cached — the registry's
+                # swap-retry must see BatcherClosed fresh every time)
+                self._cache.note_refusal(token, err)
             raise err
         if token is not None:
             # NOW the request is queued: take leadership of its new keys,

@@ -35,6 +35,16 @@ repetition cheap, in front of the batcher:
   computes every row itself, leading its new keys) — partial requests are
   never split, so batch assembly, ordering and admission semantics stay
   exactly the PR 10 machinery.
+- **negative caching**: a leader whose ADMISSION is refused (quota shed)
+  leaves a short-TTL negative entry per new key (``note_refusal``). A hot
+  row hammering an overloaded server is then answered with the same
+  refusal straight from the cache front (plan kind "refused") instead of
+  re-entering — and re-losing — admission on every request, so the
+  admission lock and shed scan stop burning CPU on traffic that cannot be
+  served anyway. The TTL is deliberately tiny (default 50 ms — the same
+  order as a batch dispatch): capacity recovers the moment the queue
+  drains, and a successful computation or hot-swap clears the verdict
+  early. Counters ``cache.negative.{stored,hit}`` on /metrics.
 
 Substrate: `utils.collections.LRUMap` with the byte-cost eviction hook.
 The cache deliberately wraps a PLAIN LRUMap under its own lock rather than
@@ -62,6 +72,7 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 from concurrent.futures import CancelledError, Future
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -129,18 +140,24 @@ class LeadToken:
 class CachePlan:
     """The admission decision: ``kind`` is "hit" (``values`` ready — the
     caller resolves the Future itself, outside any lock), "coalesced"
-    (the cache owns the Future's resolution), or "lead" (``token`` must
-    be settled when the computed Future completes)."""
+    (the cache owns the Future's resolution), "lead" (``token`` must
+    be settled when the computed Future completes), or "refused" (a row
+    key sits in the negative cache from a recent admission refusal —
+    ``error`` carries that refusal; the caller raises it synchronously
+    WITHOUT re-entering admission)."""
 
-    __slots__ = ("kind", "values", "token", "hit_rows", "coalesced_rows")
+    __slots__ = ("kind", "values", "token", "hit_rows", "coalesced_rows",
+                 "error")
 
     def __init__(self, kind: str, values=None, token=None,
-                 hit_rows: int = 0, coalesced_rows: int = 0) -> None:
+                 hit_rows: int = 0, coalesced_rows: int = 0,
+                 error: Optional[BaseException] = None) -> None:
         self.kind = kind
         self.values = values
         self.token = token
         self.hit_rows = hit_rows
         self.coalesced_rows = coalesced_rows
+        self.error = error
 
 
 class ScoreCache:
@@ -148,23 +165,34 @@ class ScoreCache:
     table for one model NAME (shared across its versions — the point:
     swap invalidation is a key change, not a flush)."""
 
-    def __init__(self, max_bytes: int, *, name: str = "default") -> None:
+    def __init__(self, max_bytes: int, *, name: str = "default",
+                 negative_ttl_s: float = 0.050) -> None:
         if max_bytes <= 0:
             raise ValueError(f"max_bytes must be > 0, got {max_bytes}")
         self.max_bytes = int(max_bytes)
         self.name = name
+        self.negative_ttl_s = float(negative_ttl_s)
         self._lock = threading.Lock()
         # entry count is unbounded by design — the byte budget is the
         # bound; the hook keeps resident accounting exact on both the
         # capacity path (never taken) and the explicit budget evictions
         self._map: LRUMap = LRUMap(1 << 62, on_evict=self._on_evict_locked)
         self._inflight: Dict[Tuple[str, bytes], _Inflight] = {}
+        # negative cache: key -> (monotonic expiry, the refusal error) —
+        # a shed/quota-refused leader key stops re-entering admission for
+        # negative_ttl_s (note_refusal / the "refused" plan kind)
+        self._negative: Dict[Tuple[str, bytes],
+                             Tuple[float, BaseException]] = {}
         self._resident = 0
         self._hit = REGISTRY.counter("serving", f"{name}.cache.hit")
         self._miss = REGISTRY.counter("serving", f"{name}.cache.miss")
         self._coalesced = REGISTRY.counter("serving",
                                            f"{name}.cache.coalesced")
         self._evicted = REGISTRY.counter("serving", f"{name}.cache.evicted")
+        self._neg_stored = REGISTRY.counter(
+            "serving", f"{name}.cache.negative.stored")
+        self._neg_hit = REGISTRY.counter(
+            "serving", f"{name}.cache.negative.hit")
         self._g_bytes = f"serving.{name}.cache.resident_bytes"
         self._g_entries = f"serving.{name}.cache.entries"
 
@@ -182,6 +210,11 @@ class ScoreCache:
         n = len(keys)
         with self._lock:
             fulls = [(version, k) for k in keys]
+            if self._negative:
+                refusal = self._negative_hit_locked(fulls)
+                if refusal is not None:
+                    self._neg_hit.increment()
+                    return CachePlan("refused", error=refusal)
             # classify with the no-rotation peek (dict.get): rows are only
             # promoted to MRU when actually SERVED from the cache below
             cached = [self._map.get(f) is not None or f in self._map
@@ -217,6 +250,43 @@ class ScoreCache:
             for f, slots in pending.items():
                 self._inflight[f].followers.append((fol, slots))
             return CachePlan("coalesced", hit_rows=hits, coalesced_rows=coal)
+
+    def _negative_hit_locked(self, fulls) -> Optional[BaseException]:
+        """The stored refusal when any requested key is negatively cached
+        and unexpired; expired entries encountered on the way are dropped
+        (the lazy half of expiry — note_refusal sweeps the rest)."""
+        now = time.monotonic()
+        for f in fulls:
+            rec = self._negative.get(f)
+            if rec is None:
+                continue
+            if rec[0] > now:
+                return rec[1]
+            del self._negative[f]
+        return None
+
+    def note_refusal(self, token: LeadToken, exc: BaseException) -> None:
+        """Admission REFUSED this leader (quota shed). Its new keys enter
+        short-TTL negative entries, so a hot row hammering an overloaded
+        server is answered with the SAME refusal from the cache front for
+        ``negative_ttl_s`` instead of re-entering admission (and losing
+        the quota race again) on every request. Version is in the key, so
+        a hot-swap clears a row's negative verdict atomically; a
+        successful computation of the key (some twin leader admitted
+        meanwhile) clears it too."""
+        if self.negative_ttl_s <= 0 or not token.led:
+            return
+        expiry = time.monotonic() + self.negative_ttl_s
+        with self._lock:
+            if len(self._negative) > 4096:  # sweep: bound stale entries
+                now = time.monotonic()
+                self._negative = {f: r for f, r in self._negative.items()
+                                  if r[0] > now}
+            for k in token.led:
+                full = (token.version, k)
+                if full not in self._negative:
+                    self._neg_stored.increment()
+                self._negative[full] = (expiry, exc)
 
     def lead(self, token: LeadToken) -> None:
         """Register the token's new keys as in-flight — called by the
@@ -315,6 +385,9 @@ class ScoreCache:
         self._evicted.increment()
 
     def _put_locked(self, full: Tuple[str, bytes], value) -> None:
+        # a key that just computed successfully is admittable again —
+        # its negative verdict (if any) is stale by proof
+        self._negative.pop(full, None)
         old = self._map.get(full)
         if old is not None or full in self._map:
             self._resident -= _entry_cost(full, old)
@@ -336,8 +409,11 @@ class ScoreCache:
             entries = len(self._map)
             resident = self._resident
             inflight = len(self._inflight)
+            negative = len(self._negative)
             hit, miss = self._hit.value, self._miss.value
             coalesced, evicted = self._coalesced.value, self._evicted.value
+            neg_stored = self._neg_stored.value
+            neg_hit = self._neg_hit.value
         looked = hit + miss
         return {
             "enabled": True,
@@ -350,6 +426,10 @@ class ScoreCache:
             "coalesced_rows": coalesced,
             "evicted_entries": evicted,
             "hit_ratio": round(hit / looked, 4) if looked else 0.0,
+            "negative_ttl_s": self.negative_ttl_s,
+            "negative_keys": negative,
+            "negative_stored": neg_stored,
+            "negative_hits": neg_hit,
         }
 
     def clear(self) -> None:
@@ -358,4 +438,5 @@ class ScoreCache:
         with self._lock:
             while len(self._map):
                 self._map.evict_oldest()
+            self._negative.clear()
             self._export_gauges_locked()

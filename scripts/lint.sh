@@ -106,6 +106,31 @@ if [[ -n "$cpp_changed" ]]; then
     fi
   done
 fi
+# the elastic-recovery spine is one failure domain: the fault injector,
+# the recovery driver, and the pipeline supervisor raise into each other,
+# and the exception-flow rules (G027-G031) prove raises ACROSS those
+# modules — an edit to either runtime half must gate the whole trio even
+# when the other files did not move
+recovery_touched=0
+for e in ${existing[@]+"${existing[@]}"}; do
+  case "$e" in
+    hivemall_tpu/runtime/faults.py|hivemall_tpu/runtime/recovery.py)
+      recovery_touched=1 ;;
+  esac
+done
+if [[ $recovery_touched -eq 1 ]]; then
+  echo "graftcheck: recovery spine changed — scanning the failure-path trio"
+  for f in hivemall_tpu/runtime/faults.py hivemall_tpu/runtime/recovery.py \
+           hivemall_tpu/pipeline/loop.py; do
+    present=0
+    for e in ${existing[@]+"${existing[@]}"}; do
+      [[ "$e" == "$f" ]] && present=1
+    done
+    if [[ $present -eq 0 && -f "$f" ]]; then
+      existing+=("$f")
+    fi
+  done
+fi
 if [[ ${#existing[@]} -eq 0 ]]; then
   echo "graftcheck: no changed python files under hivemall_tpu/"
   exit 0

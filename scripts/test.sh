@@ -21,11 +21,43 @@ bash scripts/build_native.sh --if-stale
 # side-effect/SPMD-safety/precision-flow finding fails before pytest spends
 # minutes (docs/static_analysis.md)
 bash scripts/lint.sh
-# the gate also archived its findings as SARIF; keep the path stable so CI
-# can upload it as an annotation artifact (codeql-action/upload-sarif)
-if [[ -f analysis.sarif ]]; then
-  echo "graftcheck: SARIF artifact kept at analysis.sarif"
-fi
+# next to the baseline check: overwrite the changed-files artifact with a
+# merged FULL-tree report (accepted debt included) so CI uploads ONE
+# analysis.sarif covering the whole package, and print the per-rule
+# findings/baselined/suppressions ledger so debt drift is attributable
+# per-PR instead of discovered at the next baseline refresh
+# (docs/static_analysis.md "Baseline workflow")
+python - <<'PY'
+import collections
+import json
+import os
+
+from hivemall_tpu.analysis import analyze_paths
+from hivemall_tpu.analysis.baseline import load_baseline
+from hivemall_tpu.analysis.findings import parse_suppressions
+from hivemall_tpu.analysis.sarif import render_sarif
+
+findings = analyze_paths(["hivemall_tpu"])
+live = collections.Counter(f.rule for f in findings)
+based = collections.Counter(b.rule for b in load_baseline())
+supp = collections.Counter()
+for root, _dirs, names in os.walk("hivemall_tpu"):
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        with open(os.path.join(root, name), encoding="utf-8") as fh:
+            per_line, whole_file = parse_suppressions(fh.read())
+        for rules in per_line.values():
+            supp.update(rules)
+        supp.update(whole_file)
+print("graftcheck ledger (live findings / baselined / suppressions):")
+for rule in sorted(set(live) | set(based) | set(supp)):
+    print("  %-5s %3d live  %3d baselined  %3d suppressed"
+          % (rule, live[rule], based[rule], supp[rule]))
+with open("analysis.sarif", "w", encoding="utf-8") as fh:
+    json.dump(render_sarif(findings), fh, indent=2, sort_keys=True)
+print("graftcheck: merged full-tree SARIF archived at analysis.sarif")
+PY
 
 # tier-1 gate 2: no machine-applicable fix may be left unapplied in the
 # changed files — if `--fix` would produce a diff there, fail with the

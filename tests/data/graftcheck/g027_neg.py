@@ -1,0 +1,45 @@
+"""G027 negative fixture: every hand-off resolves on all unwind paths."""
+# graftcheck: failure-path-module
+from concurrent.futures import Future
+
+
+def _parse(payload):
+    if not payload:
+        raise ValueError("empty payload")
+    return payload
+
+
+def resolved_in_finally(queue, payload):
+    fut = Future()
+    queue.put(fut)
+    try:
+        fut.set_result(_parse(payload))
+    finally:
+        if not fut.done():
+            fut.set_exception(RuntimeError("abandoned"))
+    return fut
+
+
+def handler_resolves(queue, payload):
+    fut = Future()
+    queue.put(fut)
+    try:
+        fut.set_result(_parse(payload))
+    except ValueError as exc:
+        fut.set_exception(exc)
+    return fut
+
+
+def raise_before_escape(queue, payload):
+    rows = _parse(payload)  # unwind here: the caller never got the future
+    fut = Future()
+    queue.put(fut)
+    fut.set_result(rows)
+    return fut
+
+
+def returned_not_escaped(payload):
+    fut = Future()
+    rows = _parse(payload)  # returning a future is a hand-off of the duty
+    fut.set_result(rows)
+    return fut

@@ -1,0 +1,26 @@
+"""G027 positive fixture: handed-out Futures leaked on unwind paths."""
+# graftcheck: failure-path-module
+from concurrent.futures import Future
+
+
+def _parse(payload):
+    if not payload:
+        raise ValueError("empty payload")
+    return payload
+
+
+def leak_direct_raise(queue, n):
+    fut = Future()
+    queue.put(fut)
+    if n < 0:
+        raise ValueError("bad n")  # EXPECT: G027
+    fut.set_result(n)
+    return fut
+
+
+def leak_via_callee(queue, payload):
+    f: Future = Future()
+    queue.put(f)
+    rows = _parse(payload)  # EXPECT: G027
+    f.set_result(rows)
+    return f

@@ -1,0 +1,35 @@
+"""G028 negative fixture: loud, total, probing, or reason-preserving
+fallbacks."""
+# graftcheck: failure-path-module
+import warnings
+
+
+def parse_count(raw, default=20):
+    try:
+        return int(raw)
+    except ValueError:
+        return default  # narrow catch substituting a default: total fn
+
+
+def optional_accel():
+    try:
+        import importlib
+        return importlib.import_module("json") is not None
+    except ImportError:
+        return False  # probe-only catch: version/feature probing
+
+
+def loud_fallback(fetch, stale):
+    try:
+        return fetch()
+    except Exception as exc:
+        warnings.warn(f"serving stale scores: {exc!r}", RuntimeWarning)
+        return stale
+
+
+def reason_stored(fetch, report):
+    try:
+        return fetch()
+    except RuntimeError as exc:
+        report["error"] = str(exc)  # the reason is surfaced to a reader
+        return None

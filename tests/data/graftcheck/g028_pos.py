@@ -1,0 +1,21 @@
+"""G028 positive fixture: silent degraded fallbacks."""
+# graftcheck: failure-path-module
+
+
+def _rebuild(table):
+    return dict(table)
+
+
+def score_with_stale(table, key, stale):
+    try:
+        return table[key]
+    except Exception:  # EXPECT: G028
+        return stale
+
+
+def reload_table(table):
+    try:
+        return _rebuild(table)
+    except ValueError:  # EXPECT: G028
+        table = _rebuild({})
+        return table

@@ -1,0 +1,26 @@
+"""G029 negative fixture: narrow catches and annotated silences."""
+# graftcheck: failure-path-module
+import warnings
+
+
+def probe(candidates):
+    for mod in candidates:
+        try:
+            return __import__(mod)
+        except ImportError:
+            pass  # narrow probe catch: not a broad swallow
+    return None
+
+
+def tolerated(fn):
+    try:
+        fn()
+    except Exception:  # graftcheck: disable=G029 (best-effort telemetry flush)
+        pass
+
+
+def loud_swallow(fn):
+    try:
+        fn()
+    except Exception:
+        warnings.warn("telemetry flush failed", RuntimeWarning)

@@ -1,0 +1,20 @@
+"""G029 positive fixture: broad handlers that swallow silently."""
+# graftcheck: failure-path-module
+
+
+def load_optional(path):
+    data = None
+    try:
+        with open(path) as fh:
+            data = fh.read()
+    except Exception:  # EXPECT: G029
+        pass
+    return data
+
+
+def drain(queue):
+    while not queue.empty():
+        try:
+            queue.get_nowait()
+        except:  # EXPECT: G029
+            continue

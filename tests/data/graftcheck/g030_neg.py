@@ -1,0 +1,37 @@
+"""G030 negative fixture: exception-safe locking shapes."""
+# graftcheck: failure-path-module
+import threading
+
+_LOCK = threading.Lock()
+
+
+def _decode(blob):
+    if blob is None:
+        raise ValueError("no blob")
+    return blob
+
+
+def with_statement(blob):
+    with _LOCK:
+        return _decode(blob)
+
+
+def try_finally(blob):
+    _LOCK.acquire()
+    try:
+        return _decode(blob)
+    finally:
+        _LOCK.release()
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+        self._count = 0
+
+    def put(self, key, blob):
+        rows = _decode(blob)  # compute BEFORE the first guarded write
+        with self._lock:
+            self._count = self._count + 1
+            self._rows[key] = rows

@@ -1,0 +1,31 @@
+"""G030 positive fixture: unwind-unsafe locking."""
+# graftcheck: failure-path-module
+import threading
+
+_LOCK = threading.Lock()
+
+
+def _decode(blob):
+    if blob is None:
+        raise ValueError("no blob")
+    return blob
+
+
+def manual_acquire(blob):
+    _LOCK.acquire()  # EXPECT: G030
+    rows = _decode(blob)
+    _LOCK.release()
+    return rows
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+        self._count = 0
+
+    def put(self, key, blob):
+        with self._lock:
+            self._count = self._count + 1
+            rows = _decode(blob)  # EXPECT: G030
+            self._rows[key] = rows

@@ -1,0 +1,34 @@
+"""G031 negative fixture: capped, backed-off, or escaping retries."""
+# graftcheck: failure-path-module
+import time
+
+
+def capped_with_backoff(fetch, max_attempts=5):
+    attempts = 0
+    while True:
+        try:
+            return fetch()
+        except OSError:
+            attempts += 1
+            if attempts > max_attempts:
+                raise
+            time.sleep(0.01 * attempts)
+
+
+def paced_for(fetch):
+    last = None
+    for _ in range(5):
+        try:
+            return fetch()
+        except OSError as exc:
+            last = exc
+            time.sleep(0.05)
+    raise RuntimeError(last)
+
+
+def escape_only(fetch):
+    while True:
+        try:
+            return fetch()
+        except OSError as exc:
+            raise RuntimeError("fetch failed") from exc

@@ -1,0 +1,20 @@
+"""G031 positive fixture: unbounded or unpaced retries."""
+# graftcheck: failure-path-module
+
+
+def spin_forever(fetch):
+    while True:
+        try:
+            return fetch()
+        except OSError:  # EXPECT: G031
+            continue
+
+
+def hammer(fetch):
+    last = None
+    for _ in range(5):
+        try:
+            return fetch()
+        except OSError as exc:  # EXPECT: G031
+            last = exc
+    raise RuntimeError(last)

@@ -314,6 +314,11 @@ def test_quota_refused_leader_registers_nothing():
         wedge.result(5)
         filler.result(5)
         assert cache.stats()["inflight_keys"] == 0
+        # the refusal left a short-TTL negative entry for key 80 (PR 17:
+        # a hot refused row repeats its refusal from the cache front);
+        # wait it out — THIS test is about leadership release, not the
+        # negative cache (covered in test_negative_cache_* below)
+        time.sleep(cache.negative_ttl_s + 0.01)
         assert b.submit([80]).result(5) == [80.0]  # fresh leader works
     finally:
         gate.set()
@@ -491,3 +496,85 @@ def test_cache_module_in_dtypeflow_hot_scope():
     assert any("hivemall_tpu/serving/".startswith(p) or
                "hivemall_tpu/serving/cache.py".startswith(p)
                for p in config.CONCURRENCY_HOT_PREFIXES)
+
+
+# -- negative caching (PR 17): quota-refused hot rows -------------------------
+
+def _wedged_full_batcher(name, *, negative_ttl_s=0.05):
+    """A batcher wedged mid-dispatch with a full 1-row queue: every new
+    submit is quota-refused. Returns (batcher, cache, release_fn)."""
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def predict(rows):
+        entered.set()
+        gate.wait(10)
+        return [float(r) for r in rows]
+
+    cache = ScoreCache(1 << 20, name=name, negative_ttl_s=negative_ttl_s)
+    b = DynamicBatcher(predict, name=name, cache=cache, cache_version="1",
+                       row_key_fn=_keyfn, max_batch=1, max_delay_ms=0.5,
+                       max_queue_rows=1, express_high=False)
+    wedged = [b.submit([1])]
+    assert entered.wait(5)
+    wedged.append(b.submit([2]))  # queue is now at quota
+    return b, cache, gate.set, wedged
+
+
+def test_negative_cache_short_circuits_repeat_refusals():
+    """A quota-refused leader key answers the SAME refusal from the cache
+    front within the TTL — the repeat request never re-enters admission
+    (accepted/rejected admission counters stay untouched)."""
+    b, cache, release, _wedged = _wedged_full_batcher("sc_neg")
+    try:
+        with pytest.raises(QueueFull):
+            b.submit([80])  # refused at admission: stores a negative entry
+        st = cache.stats()
+        assert st["negative_stored"] == 1 and st["negative_keys"] == 1
+        rejected_before = REGISTRY.snapshot().get(
+            "serving.sc_neg.batcher.rejected", 0)
+        with pytest.raises(QueueFull):
+            b.submit([80])  # within TTL: refused by the negative cache
+        assert cache.stats()["negative_hits"] == 1
+        after = REGISTRY.snapshot().get(
+            "serving.sc_neg.batcher.rejected", 0)
+        assert after == rejected_before  # admission never saw the repeat
+    finally:
+        release()
+        b.close()
+
+
+def test_negative_entry_expires_and_clears_on_success():
+    """The verdict is short-lived by design: after the TTL the key
+    re-enters admission, and a successful computation removes the entry
+    immediately (capacity provably recovered for that row)."""
+    b, cache, release, wedged = _wedged_full_batcher("sc_neg_ttl",
+                                                     negative_ttl_s=0.03)
+    try:
+        with pytest.raises(QueueFull):
+            b.submit([80])
+        release()
+        for f in wedged:  # drain the queue so admission has capacity
+            f.result(5)
+        time.sleep(0.04)  # TTL elapsed: admission is consulted again
+        assert b.submit([80]).result(5) == [80.0]
+        st = cache.stats()
+        assert st["negative_keys"] == 0  # success purged the entry
+        assert st["negative_hits"] == 0  # expired entry never served
+    finally:
+        release()
+        b.close()
+
+
+def test_negative_cache_is_version_keyed():
+    """A hot-swap clears a row's negative verdict atomically — the
+    version is in the key, exactly like positive entries."""
+    cache = ScoreCache(1 << 20, name="sc_neg_ver", negative_ttl_s=30.0)
+    from hivemall_tpu.serving.cache import LeadToken
+
+    refusal = QueueFull("full", reason="quota")
+    cache.note_refusal(LeadToken("1", [b"k"], [b"k"]), refusal)
+    plan = cache.admit("1", [b"k"], None)
+    assert plan.kind == "refused" and plan.error is refusal
+    plan2 = cache.admit("2", [b"k"], None)  # new version: clean slate
+    assert plan2.kind == "lead"
