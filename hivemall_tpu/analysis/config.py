@@ -151,6 +151,12 @@ DTYPEFLOW_HOT_MODULES = ("hivemall_tpu/serving/engine.py",
                          # (G019) and f32 accumulation (G021), same
                          # contract as the single-device _q8_* scorers
                          "hivemall_tpu/serving/sharded.py",
+                         # the top-K retrieval path: the blocked catalog
+                         # scorers carry the same dequant-free contract
+                         # (int8 window widen + scale fold, f32
+                         # accumulation) at catalog scale — a full-table
+                         # dequant here costs N_items, not a window
+                         "hivemall_tpu/serving/retrieval.py",
                          "hivemall_tpu/io/checkpoint.py",
                          # the segment-sum batched trainer: the CPU hot
                          # path — gathered [U]-window widens only, f32

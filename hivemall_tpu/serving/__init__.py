@@ -1,6 +1,6 @@
 """Online inference: frozen artifacts, bucketed engines, overload-grade
 micro-batching (priorities / quotas / deadlines / adaptive windows),
-hot-swap registry + /predict endpoint — docs/serving.md.
+hot-swap registry + /predict and top-K /topk endpoints — docs/serving.md.
 
     from hivemall_tpu.serving import freeze, ModelRegistry, serve
 
@@ -18,6 +18,7 @@ from .cache import ScoreCache
 from .engine import Servable, ServingEngine, make_servable
 from .placement import (ModelExceedsDeviceBudget, ModelSharded, Placement,
                         Replicated, SingleDevice)
+from .retrieval import RetrievalEngine, SRPIndex, build_srp_index
 from .server import ModelEntry, ModelRegistry, serve
 
 __all__ = [
@@ -28,5 +29,6 @@ __all__ = [
     "Servable", "ServingEngine", "make_servable",
     "Placement", "SingleDevice", "Replicated", "ModelSharded",
     "ModelExceedsDeviceBudget",
+    "RetrievalEngine", "SRPIndex", "build_srp_index",
     "ModelRegistry", "ModelEntry", "serve",
 ]

@@ -349,9 +349,54 @@ def _build_quantized_payload(model, quantize: str, block_rows: int):
     return family, arrays, meta
 
 
+def _add_retrieval_index(model, family: str, arrays: dict, meta: dict,
+                         opts: dict) -> None:
+    """Build the retrieval LSH index into a freeze payload (freeze's
+    ``retrieval_index=``): SRP buckets over the model's f32 item vectors
+    — always the pre-quantization tables, so a bf16/int8 artifact carries
+    the same index as its f32 twin."""
+    import jax
+
+    if family not in ("mf", "fm"):
+        raise ValueError(
+            f"retrieval_index: family {family!r} has no retrieval path "
+            f"(mf/fm only)")
+    n_planes = int(opts.pop("planes", 8))
+    seed = int(opts.pop("seed", 0))
+    item_range = opts.pop("item_range", None)
+    if opts:
+        raise ValueError(
+            f"retrieval_index: unknown keys {sorted(opts)} (accepted: "
+            f"planes, seed, item_range)")
+    if family == "mf":
+        vecs = np.asarray(jax.device_get(model.state.Q), np.float32)
+        full = (0, vecs.shape[0])
+    else:
+        vecs = np.asarray(jax.device_get(model.state.v), np.float32)
+        full = (0, vecs.shape[0])
+    if item_range is None:
+        lo, hi = full
+    else:
+        lo, hi = int(item_range[0]), int(item_range[1])
+        if not (full[0] <= lo < hi <= full[1]):
+            raise ValueError(
+                f"retrieval_index: item_range ({lo}, {hi}) outside the "
+                f"model's {full}")
+    from .retrieval import build_srp_index
+
+    planes, item_ids, offsets = build_srp_index(vecs[lo:hi], n_planes,
+                                                seed, item_lo=lo)
+    arrays["index__planes"] = planes
+    arrays["index__item_ids"] = item_ids
+    arrays["index__offsets"] = offsets
+    meta["index"] = {"scheme": "srp_lsh", "planes": n_planes,
+                     "seed": seed, "item_lo": lo, "item_hi": hi}
+
+
 def freeze(model, path: str, *, name: Optional[str] = None,
            version: Optional[str] = None, quantize: Optional[str] = None,
-           quant_block_rows: Optional[int] = None) -> dict:
+           quant_block_rows: Optional[int] = None,
+           retrieval_index: Optional[dict] = None) -> dict:
     """Freeze a trained model into an immutable artifact directory.
 
     Returns the manifest. The directory must not already hold an artifact
@@ -363,6 +408,16 @@ def freeze(model, path: str, *, name: Optional[str] = None,
     engine then scores them dequant-free at the manifest dtype.
     ``quant_block_rows`` sets the int8 scale-block row count (power of
     two; default io.checkpoint.QUANT_BLOCK_ROWS).
+
+    ``retrieval_index={"planes": int, "seed": int, "item_range": (lo, hi)}``
+    (MF/FM only, every key optional) additionally builds the top-K
+    retrieval LSH index into the artifact: signed-random-projection
+    buckets over the item vectors (MF: Q rows; FM: v rows over
+    ``item_range``, default the full feature space) as ``index__*``
+    arrays plus a manifest ``meta["index"]`` block. The index hashes the
+    f32 vectors BEFORE quantization — it approximates angles, not stored
+    bits — and is deterministic in ``seed``
+    (serving/retrieval.py; docs/serving.md "Top-K retrieval").
     """
     os.makedirs(path, exist_ok=True)
     mpath = os.path.join(path, MANIFEST_FILE)
@@ -382,6 +437,9 @@ def freeze(model, path: str, *, name: Optional[str] = None,
     else:
         raise ValueError(f"quantize must be 'bf16' or 'int8', "
                          f"got {quantize!r}")
+    if retrieval_index is not None:
+        _add_retrieval_index(model, family, arrays, meta,
+                             dict(retrieval_index))
     apath = os.path.join(path, ARRAYS_FILE)
     # savez into memory so the pack is written AND hashed in one pass (a
     # large FM/FFM table would otherwise pay a second full-file read)

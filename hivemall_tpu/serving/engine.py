@@ -204,10 +204,11 @@ class _Servable:
         """Per-row canonical cache keys for the hot-row score cache
         (serving/cache.py), or None when this request — or this family —
         is not cacheable. The key hashes the canonical PRE-PARSED row
-        form (what staging actually scores: ids mod dims, f32 values), so
-        a string row and its pre-parsed twin share one cache line. The
-        default is None: families whose request form has no cheap
-        canonical key (trees, FFM) simply bypass the cache."""
+        form (what staging actually scores: ids mod dims, f32 values for
+        the sparse families; binned int32 rows for trees; normalized
+        (field, id, value) triples for FFM), so a string row and its
+        pre-parsed twin share one cache line. The default is None —
+        uncacheable — for any family without an override."""
         return None
 
     def run_padded(self, instances, b_pad: int, width_cap: int):
@@ -508,6 +509,41 @@ class _FFMServable(_Servable):
     def dummy_instance(self, width):
         return [f"{k % 8}:{k}:1.0" for k in range(width)]
 
+    def row_keys(self, instances, width_cap: int):
+        """blake2b-128 over the canonical (field, id, value) triples —
+        ids mod num_features, fields normalized exactly as staging does
+        (negative -> 0, mod num_fields), values f32 — so a string row and
+        a differently-written equivalent share one cache line. Rows wider
+        than ``width_cap`` make the request uncacheable (truncation
+        semantics live in staging, not here); unparseable rows too — the
+        parse error re-surfaces on the predict path with its real
+        message."""
+        from hashlib import blake2b
+
+        from ..utils.feature import FMFeature
+
+        hy = self.hyper
+        keys = []
+        try:
+            for row in instances:
+                if len(row) > width_cap:
+                    return None
+                idx = np.empty(len(row), np.int64)
+                fld = np.empty(len(row), np.int64)
+                val = np.empty(len(row), np.float32)
+                for c, f in enumerate(row):
+                    p = FMFeature.parse(f, num_features=hy.num_features,
+                                        num_fields=hy.num_fields)
+                    idx[c] = p.index % hy.num_features
+                    fld[c] = (p.field if p.field >= 0 else 0) % hy.num_fields
+                    val[c] = p.value
+                keys.append(blake2b(
+                    idx.tobytes() + fld.tobytes() + val.tobytes(),
+                    digest_size=16).digest())
+        except Exception:  # graftcheck: disable=G028 (None = uncacheable; the error re-surfaces on the predict path)
+            return None
+        return keys
+
 
 class _PairServable(_Servable):
     """Shared (user, item) pair staging for the MF servables (f32 and
@@ -728,6 +764,26 @@ class _TreeServable(_Servable):
 
     def dummy_instance(self, width):
         return [0.0] * self.n_features
+
+    def row_keys(self, instances, width_cap: int):
+        """blake2b-128 over the BINNED row (int32 bin ids) — the canonical
+        form the tree walk actually consumes, so any two raw rows that
+        bin identically share one cache line (and an edge-straddling
+        perturbation correctly does not). Malformed requests are
+        uncacheable (None); the shape error re-surfaces on the predict
+        path."""
+        from hashlib import blake2b
+
+        from ..models.trees.binning import bin_data
+
+        try:
+            X = np.asarray(instances, self.stage_dtype).reshape(
+                len(instances), self.n_features)
+        except (TypeError, ValueError):
+            return None
+        Xb = np.ascontiguousarray(bin_data(X, self.bins), np.int32)
+        return [blake2b(row.tobytes(), digest_size=16).digest()
+                for row in Xb]
 
 
 class _ForestServable(_TreeServable):

@@ -22,6 +22,12 @@ HTTP surface (layered on runtime/metrics_http.py — same process, one port):
   model, 400 bad payload. A client ``traceparent`` header (W3C) is
   adopted as the request trace's root parent and echoed back on every
   response; malformed headers fall back to a fresh trace;
+- ``POST /topk``     body ``{"model": name?, "queries": [...], "k"?,
+  "probe"?}`` -> ``{"model", "version", "k", "results": [{"items",
+  "scores"}, ...]}``. The top-K retrieval surface (serving/retrieval.py)
+  — deploy() must have been given ``retrieval=`` options for the model
+  (400 otherwise). Same priority/deadline/traceparent contract and error
+  mapping as /predict, through the model's SEPARATE retrieval batcher;
 - ``GET /models``    registry listing (name, version, family, admission
   and placement state, counters);
 - ``GET /healthz``   overload-aware: reports ``degraded`` (still 200 —
@@ -58,7 +64,9 @@ class ModelEntry:
 
     def __init__(self, name: str, version: str, engine: ServingEngine,
                  batcher: DynamicBatcher,
-                 lineage: Optional[list] = None, cache=None) -> None:
+                 lineage: Optional[list] = None, cache=None,
+                 retrieval_engine=None,
+                 retrieval_batcher: Optional[DynamicBatcher] = None) -> None:
         self.name = name
         self.version = version
         self.engine = engine
@@ -67,6 +75,12 @@ class ModelEntry:
         # owned by the REGISTRY and shared across this name's versions
         # (the version lives in the key; serving/cache.py). None = off.
         self.cache = cache
+        # the top-K retrieval surface (serving/retrieval.py): present only
+        # when deploy() was given ``retrieval=`` options and the family is
+        # MF/FM. Its batcher is separate from the pointwise one — a /topk
+        # flood cannot starve /predict of dispatch slots, and vice versa.
+        self.retrieval_engine = retrieval_engine
+        self.retrieval_batcher = retrieval_batcher
         self.deployed_unix = time.time()
         # version lineage: the publisher's recent gate decisions (publish /
         # refusal / rollback records — hivemall_tpu/pipeline) surfaced on
@@ -105,6 +119,12 @@ class ModelEntry:
             # publisher lineage: recent gate decisions for this model's
             # version sequence (empty for hand-deployed models)
             "lineage": [dict(d) for d in self.lineage],
+            # the top-K retrieval surface: catalog size, block/K geometry,
+            # sharding and LSH index state (docs/serving.md "Top-K
+            # retrieval"). {"enabled": False} = /topk 400s for this model.
+            "retrieval": {"enabled": True,
+                          **self.retrieval_engine.describe()}
+            if self.retrieval_engine is not None else {"enabled": False},
         }
 
 
@@ -169,6 +189,7 @@ class ModelRegistry:
                batcher_overrides: Optional[dict] = None,
                lineage: Optional[list] = None,
                score_cache_bytes: Optional[int] = None,
+               retrieval: Optional[dict] = None,
                **engine_overrides) -> ModelEntry:
         """Deploy `source` (artifact dir path, Artifact, or trained model)
         as `name`; replaces any current version atomically AFTER the new
@@ -188,7 +209,14 @@ class ModelRegistry:
         failing that, whatever cache an earlier deploy enabled for this
         name; an explicit 0 disables); the cache OBJECT persists across
         this name's versions — swap invalidation is the version key, not
-        a flush (docs/serving.md "Score caching & coalescing")."""
+        a flush (docs/serving.md "Score caching & coalescing").
+        ``retrieval`` (a dict of RetrievalEngine kwargs, ``{}`` for the
+        defaults) additionally stands up the top-K catalog-scoring surface
+        for this model — MF/FM only — behind its OWN DynamicBatcher, so
+        ``POST /topk`` rides the same admission/priority/deadline
+        machinery without sharing dispatch slots with /predict
+        (docs/serving.md "Top-K retrieval"). Opt-in: None (default) means
+        /topk answers 400 for this model."""
         from .artifact import Artifact, load as load_artifact
 
         if isinstance(source, str):
@@ -236,11 +264,40 @@ class ModelRegistry:
             # (old-version entries age out of the byte budget)
             with self._lock:
                 cache = self._caches.get(name)
+        r_engine = r_batcher = None
+        if retrieval is not None:
+            from .retrieval import RetrievalEngine
+
+            rkw = dict(retrieval)
+            # the catalog shards wherever the pointwise tables do unless
+            # the retrieval options say otherwise
+            if kw.get("placement") is not None:
+                rkw.setdefault("placement", kw.get("placement"))
+            r_engine = RetrievalEngine(source, name=name, **rkw)
+            if self.warmup:
+                r_engine.warmup()
+            rbkw = dict(max_batch=r_engine.max_batch,
+                        max_delay_ms=self.max_delay_ms,
+                        max_queue_rows=self.max_queue_rows,
+                        max_delay_ms_cap=self.max_delay_ms_cap,
+                        max_batch_cap=self.max_batch_cap,
+                        priority_quota_fracs=self.priority_quota_fracs,
+                        starvation_limit=self.starvation_limit,
+                        express_high=self.express_high)
+            rbkw.update(batcher_overrides or {})
+            rbkw["max_batch"] = r_engine.max_batch
+            # no score cache / row keys: a top-K row is (query, k, probe)
+            # and the result is a ranking, not a scalar — the hot-row
+            # cache's single-score contract doesn't apply
+            r_batcher = DynamicBatcher(r_engine.topk_batch,
+                                       name=f"{name}.topk", **rbkw)
         batcher = DynamicBatcher(engine.predict, name=name, cache=cache,
                                  cache_version=str(version),
                                  row_key_fn=engine.row_keys, **bkw)
         entry = ModelEntry(name, str(version), engine, batcher,
-                           lineage=lineage, cache=cache)
+                           lineage=lineage, cache=cache,
+                           retrieval_engine=r_engine,
+                           retrieval_batcher=r_batcher)
         with self._lock:
             old = self._entries.get(name)
             self._entries[name] = entry  # the atomic publish
@@ -248,6 +305,8 @@ class ModelRegistry:
             self._swaps.increment()
             # outside the lock: draining can take max_delay + a batch
             old.batcher.close(drain=True)
+            if old.retrieval_batcher is not None:
+                old.retrieval_batcher.close(drain=True)
         REGISTRY.set_gauge(f"serving.{name}.deployed_version",
                            float(version) if str(version).isdigit() else 0.0)
         return entry
@@ -296,6 +355,29 @@ class ModelRegistry:
             f"model {name!r}: {self._SWAP_RETRIES} consecutive version "
             f"swaps collided with this submit — retry")
 
+    def submit_topk(self, name: Optional[str], rows, *,
+                    priority="normal", deadline_ms: Optional[float] = None):
+        """submit(), but into the model's RETRIEVAL batcher. ``rows`` is a
+        list of ``(query, k, probe)`` tuples (serving/retrieval.py
+        ``topk_batch``). Returns (entry, future); (None, None) means the
+        name is unknown; (entry, None) means the model is deployed but
+        without a retrieval surface (deploy() had no ``retrieval=`` — the
+        caller's 400). Swap-retry semantics match submit()."""
+        for _ in range(self._SWAP_RETRIES):
+            entry = self.get(name)
+            if entry is None:
+                return None, None
+            if entry.retrieval_batcher is None:
+                return entry, None
+            try:
+                return entry, entry.retrieval_batcher.submit(
+                    rows, priority=priority, deadline_ms=deadline_ms)
+            except BatcherClosed:  # graftcheck: disable=G031 (retry rebinds to the NEW batcher; waiting adds only latency)
+                continue
+        raise BatcherClosed(
+            f"model {name!r}: {self._SWAP_RETRIES} consecutive version "
+            f"swaps collided with this submit — retry")
+
     def health(self) -> dict:
         """Overload-aware health: ``degraded`` (still alive — shedding
         predictably) when any model's queue fills past
@@ -337,6 +419,8 @@ class ModelRegistry:
         if entry is None:
             return False
         entry.batcher.close(drain=True)
+        if entry.retrieval_batcher is not None:
+            entry.retrieval_batcher.close(drain=True)
         return True
 
     def list_models(self):
@@ -351,6 +435,8 @@ class ModelRegistry:
             self._caches = {}
         for e in entries:
             e.batcher.close(drain=True)
+            if e.retrieval_batcher is not None:
+                e.retrieval_batcher.close(drain=True)
 
 
 class _ServingHandler(metrics_http._Handler):
@@ -398,7 +484,8 @@ class _ServingHandler(metrics_http._Handler):
         self.rfile.read(length)
 
     def do_POST(self):  # noqa: N802 - http.server API
-        if self.path.split("?")[0] != "/predict":
+        route = self.path.split("?")[0]
+        if route not in ("/predict", "/topk"):
             self._drain_body()
             self._send_json(404, {"error": "not found"})
             return
@@ -433,7 +520,7 @@ class _ServingHandler(metrics_http._Handler):
                                 extra_headers=(("Retry-After", "1"),))
                 return
         try:
-            self._predict()
+            self._topk() if route == "/topk" else self._predict()
         finally:
             if held is not None:
                 held.release()
@@ -536,6 +623,114 @@ class _ServingHandler(metrics_http._Handler):
                 "model": entry.name,
                 "version": entry.version,
                 "predictions": [_jsonable(p) for p in preds],
+            }, extra_headers=tp_hdr)
+
+    def _topk(self) -> None:
+        # /predict's twin for the retrieval surface: same root-span /
+        # traceparent / priority / deadline / error-mapping contract, but
+        # the rows are (query, k, probe) tuples into the model's SEPARATE
+        # retrieval batcher and the answer is a ranking per query
+        # (docs/serving.md "Top-K retrieval")
+        remote = TRACER.parse_traceparent(self.headers.get("traceparent"))
+        with TRACER.span("server.topk", remote=remote) as root:
+            tp = TRACER.format_traceparent(root)
+            tp_hdr = (("traceparent", tp),) if tp else ()
+            with TRACER.span("server.parse"):
+                close_hdr = ()
+                try:
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                    except ValueError:
+                        close_hdr = (("Connection", "close"),)
+                        raise
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    queries = payload["queries"]
+                    if not isinstance(queries, list):
+                        raise TypeError("queries must be a list")
+                    k = payload.get("k")
+                    if k is not None:
+                        k = int(k)
+                        if k < 1:
+                            raise ValueError(f"k must be >= 1, got {k}")
+                    probe = payload.get("probe")
+                    if probe is not None:
+                        probe = bool(probe)
+                    cls = priority_class(
+                        payload.get("priority",
+                                    self.headers.get("x-priority")
+                                    or "normal"))
+                    deadline_ms = payload.get(
+                        "deadline_ms", self.headers.get("x-deadline-ms"))
+                    if deadline_ms is not None:
+                        deadline_ms = float(deadline_ms)
+                        if not math.isfinite(deadline_ms) \
+                                or deadline_ms <= 0:
+                            raise ValueError(
+                                f"deadline_ms must be a positive number, "
+                                f"got {deadline_ms}")
+                except (KeyError, TypeError, ValueError) as e:
+                    self._send_json(400, {"error": f"bad request: {e}"},
+                                    extra_headers=tp_hdr + close_hdr)
+                    root.set(status=400)
+                    return
+            root.set(queries=len(queries),
+                     model=payload.get("model") or "",
+                     priority=priority_name(cls),
+                     **({"k": k} if k is not None else {}),
+                     **({"deadline_ms": deadline_ms}
+                        if deadline_ms is not None else {}))
+            t0 = time.perf_counter()
+            try:
+                rows = [(q, k, probe) for q in queries]
+                entry, future = self.server.registry.submit_topk(
+                    payload.get("model"), rows,
+                    priority=cls, deadline_ms=deadline_ms)
+                if entry is None:
+                    self._send_json(404,
+                                    {"error": f"unknown model "
+                                              f"{payload.get('model')!r}"},
+                                    extra_headers=tp_hdr)
+                    root.set(status=404)
+                    return
+                if future is None:
+                    # deployed, but deploy() stood up no retrieval surface
+                    self._send_json(
+                        400, {"error": f"model {entry.name!r} has no "
+                                       f"retrieval surface (deploy with "
+                                       f"retrieval= to enable /topk)"},
+                        extra_headers=tp_hdr)
+                    root.set(status=400)
+                    return
+                results = future.result(timeout=self.predict_timeout)
+            except DeadlineExpired as e:
+                self._send_json(504, {"error": str(e),
+                                      "reason": "deadline"},
+                                extra_headers=tp_hdr)
+                root.set(status=504)
+                return
+            except (QueueFull, BatcherClosed) as e:
+                ra = getattr(e, "retry_after_s", None) or 1.0
+                self._send_json(
+                    503, {"error": str(e),
+                          "reason": getattr(e, "reason", "busy")},
+                    extra_headers=tp_hdr + (
+                        ("Retry-After", str(int(math.ceil(ra)))),))
+                root.set(status=503)
+                return
+            except Exception as e:  # scoring bug — surface, don't hang
+                self._send_json(500, {"error": f"{type(e).__name__}: {e}"},
+                                extra_headers=tp_hdr)
+                root.set(status=500)
+                return
+            self.server.latency.observe(
+                time.perf_counter() - t0,
+                trace_id=TRACER.exemplar_id(root))
+            root.set(status=200, version=entry.version)
+            self._send_json(200, {
+                "model": entry.name,
+                "version": entry.version,
+                "k": k if k is not None else entry.retrieval_engine.k,
+                "results": list(results),
             }, extra_headers=tp_hdr)
 
 

@@ -74,6 +74,20 @@ every precision (f32/bf16/int8), a mid-bench hot-swap that must fail zero
 requests and never label an old version's score with the new version, and
 zero steady-state recompiles. ``--smoke`` is tier-1 gate 10.
 
+`--topk` switches to the top-K retrieval bench (docs/serving.md "Top-K
+retrieval"): one MF model is trained, frozen WITH a signed-random-
+projection index (freeze(retrieval_index=...)) and served through a
+RetrievalEngine; interleaved paired trials report exact and LSH-pruned
+queries/sec over the blocked-streamed catalog. Hard gates, smoke or not:
+the blocked merge must be BIT-identical (ids and f32 scores) to a
+stable argsort over the materialized catalog scores, pruned recall@K
+must hold ``--recall-floor`` (the recall/candidate-fraction/speedup
+trade is reported), and sharded catalogs (model-axis stripes, >= 2
+devices) must reproduce single-device scores within
+``--parity-tol-score``. ``--smoke`` additionally gates zero
+steady-state recompiles and a non-vacuous pruned path — tier-1 gate 11
+in scripts/test.sh.
+
 `--overload` switches to the overload sweep (docs/serving.md "Overload
 behavior"): a closed-loop calibration pins the saturation throughput,
 then stepped open-loop offered load (0.25x .. 2x saturation) drives
@@ -645,6 +659,223 @@ def run_sharded_mode(args) -> int:
     if args.smoke and any(steady.values()):
         print(f"SMOKE FAIL: steady_state_recompiles={steady}",
               file=sys.stderr)
+        return 1
+    return 0
+
+
+def run_topk_mode(args) -> int:
+    """Top-K retrieval bench: queries/sec against a blocked-streamed MF
+    catalog (serving/retrieval.py — docs/serving.md "Top-K retrieval"),
+    with the subsystem's correctness pins gated alongside the number:
+
+    - **exact parity** (hard gate, always): the blocked streamed merge
+      must be BIT-identical — ids and f32 scores — to a stable argsort
+      over the materialized catalog scores. ``score_catalog`` shares the
+      block score expression with the merge, so any drift here is merge
+      logic, not arithmetic;
+    - **pruned recall@K** (hard gate, always): the signed-random-
+      projection probe (index built at freeze time into the artifact)
+      must keep mean recall@K vs exact scoring >= ``--recall-floor``,
+      with the recall / candidate-fraction / speedup trade reported —
+      the AdaBatch-style gate: pruning that loses more recall than the
+      pin is a regression whether or not it is faster;
+    - **sharded score parity** (hard gate when >= 2 devices): the
+      model-axis-striped catalog must reproduce single-device top-K
+      scores within ``--parity-tol-score`` at equal model (the
+      cross-stripe merge may permute equal-score ties, so scores gate
+      and id agreement is reported);
+    - **zero steady-state recompiles** (hard gate under --smoke): after
+      warmup, the whole sweep — exact and probed, every batch and
+      candidate bucket — leaves the recompile counters flat, and at
+      least one probed query must actually take the pruned path (a
+      100%-fallback run would gate recall vacuously).
+
+    ``--smoke`` is tier-1 gate 11 in scripts/test.sh.
+    """
+    import os
+    import tempfile
+
+    import jax
+
+    from hivemall_tpu.models.mf import train_mf_sgd
+    from hivemall_tpu.serving import ModelSharded, RetrievalEngine
+    from hivemall_tpu.serving.artifact import freeze
+
+    n_items = args.catalog_items
+    k = args.topk_k
+    n_users = min(1024, max(16, n_items // 8))
+    rng = np.random.RandomState(11)
+    n_r = args.train_rows
+    u = rng.randint(0, n_users, n_r)
+    it = rng.randint(0, n_items, n_r)
+    rat = rng.rand(n_r) * 4 + 1
+    u[-1], it[-1] = n_users - 1, n_items - 1  # pin the table shapes
+    t0 = time.perf_counter()
+    model = train_mf_sgd(u, it, rat,
+                         f"-factor {args.mf_factor} -iter 2 -disable_cv")
+    train_s = time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as td:
+        # freeze -> load: the bench measures the artifact path — the LSH
+        # index rides the manifest, exactly what production serves
+        art_dir = os.path.join(td, "mf", "1")
+        freeze(model, art_dir,
+               retrieval_index={"planes": args.lsh_planes, "seed": 0})
+        art = load(art_dir)
+        # candidate cap sized from the probe's expected union: 1+planes
+        # Hamming<=1 buckets of ~n/2^planes items each, doubled for
+        # bucket skew (the engine pow2-rounds)
+        expected_cand = int(n_items * (1 + args.lsh_planes)
+                            / (1 << args.lsh_planes))
+        cand_cap = max(64, 2 * expected_cand)
+        geom = dict(k=k, block_items=args.topk_block_items,
+                    max_batch=args.max_batch, candidate_cap=cand_cap)
+        eng = RetrievalEngine(art, name="topk_bench", **geom)
+        t0 = time.perf_counter()
+        warm_compiles = eng.warmup()
+        warm_s = time.perf_counter() - t0
+
+        qrng = np.random.RandomState(23)
+        qs = qrng.randint(0, n_users, args.topk_queries).tolist()
+        guard = REGISTRY.counter("graftcheck",
+                                 "recompiles.serving.topk_bench.topk")
+        recompiles0 = guard.value
+
+        # -- exact parity pin: blocked merge == stable argsort, bit for bit
+        n_par = min(len(qs), max(8, args.max_batch))
+        par_q = qs[:n_par]
+        res_exact_par = eng.topk(par_q, probe=False)
+        scores = eng.score_catalog(par_q)  # [n_par, n_items] f32
+        bit_exact = True
+        for row, res in zip(scores, res_exact_par):
+            order = np.argsort(-row, kind="stable")[:k]
+            if not (np.array_equal(np.asarray(res["items"], np.int64),
+                                   order)
+                    and np.array_equal(
+                        np.asarray(res["scores"], np.float32),
+                        row[order])):
+                bit_exact = False
+                break
+
+        # -- pruned recall@K vs exact, fallbacks and candidate volume
+        p0 = REGISTRY.counter("retrieval", "topk_bench.probed").value
+        f0 = REGISTRY.counter("retrieval", "topk_bench.fallback").value
+        c0 = REGISTRY.counter("retrieval", "topk_bench.candidates").value
+        res_probe = eng.topk(qs, probe=True)
+        res_exact = eng.topk(qs, probe=False)
+        probed = int(REGISTRY.counter("retrieval",
+                                      "topk_bench.probed").value - p0)
+        fallbacks = int(REGISTRY.counter("retrieval",
+                                         "topk_bench.fallback").value - f0)
+        cands = int(REGISTRY.counter("retrieval",
+                                     "topk_bench.candidates").value - c0)
+        recalls = [len(set(p["items"]) & set(e["items"])) / len(e["items"])
+                   for p, e in zip(res_probe, res_exact)]
+        recall = float(np.mean(recalls))
+        avg_cand = cands / probed if probed else 0.0
+
+        # -- throughput: interleaved paired exact/probed trials
+        rows_exact = [(q, None, False) for q in qs]
+        rows_probe = [(q, None, True) for q in qs]
+        exact_qps, probe_qps = [], []
+        for _ in range(args.quant_trials):
+            t0 = time.perf_counter()
+            eng.topk_batch(rows_exact)
+            exact_qps.append(len(qs) / (time.perf_counter() - t0))
+            t0 = time.perf_counter()
+            eng.topk_batch(rows_probe)
+            probe_qps.append(len(qs) / (time.perf_counter() - t0))
+        steady = int(guard.value - recompiles0)
+
+        # -- sharded catalog: score parity with single-device at equal model
+        ndev = jax.device_count()
+        shard_counts = [m for m in (2, 4) if m <= ndev]
+        sharded_block, sharded_ok = {}, True
+        for m in shard_counts:
+            eng_sh = RetrievalEngine(art, name=f"topk_sh{m}",
+                                     placement=ModelSharded(m), **geom)
+            eng_sh.warmup()
+            g_sh = REGISTRY.counter(
+                "graftcheck", f"recompiles.serving.topk_sh{m}.topk")
+            r_sh0 = g_sh.value
+            res_sh = eng_sh.topk(par_q, probe=False)
+            max_rel, ids_equal = 0.0, True
+            for a, b in zip(res_sh, res_exact_par):
+                va = np.asarray(a["scores"], np.float32)
+                vb = np.asarray(b["scores"], np.float32)
+                scale = float(np.max(np.abs(vb))) or 1.0
+                max_rel = max(max_rel,
+                              float(np.max(np.abs(va - vb))) / scale)
+                ids_equal = ids_equal and a["items"] == b["items"]
+            ok = max_rel <= args.parity_tol_score
+            sharded_ok = sharded_ok and ok
+            sharded_block[f"shards_{m}"] = {
+                "max_rel_score_delta": max_rel, "ids_equal": ids_equal,
+                "steady_state_recompiles": int(g_sh.value - r_sh0),
+                "ok": ok}
+
+    exact_med = float(np.median(exact_qps))
+    probe_med = float(np.median(probe_qps))
+    result = {
+        "metric": f"serving_topk_qps_mf_{n_items}items",
+        "value": round(exact_med, 1),
+        "unit": "queries/s",
+        "methodology": "in_process_engine_interleaved_paired_trials",
+        "device_set": _device_set(),
+        "catalog_items": int(n_items),
+        "k": int(k),
+        "factor": int(args.mf_factor),
+        "block_items": int(args.topk_block_items),
+        "queries": len(qs),
+        "trials": int(args.quant_trials),
+        "train": {"ratings": int(n_r), "users": int(n_users),
+                  "seconds": round(train_s, 3)},
+        "warmup": {"compiles": int(warm_compiles),
+                   "seconds": round(warm_s, 3)},
+        "steady_state_recompiles": steady,
+        "exact": {
+            "qps": round(exact_med, 1),
+            "items_scored_per_sec": round(exact_med * n_items, 0),
+            "bit_exact_vs_argsort": bit_exact,
+            "parity_queries": int(n_par),
+        },
+        "pruned": {
+            "qps": round(probe_med, 1),
+            "speedup_x": round(probe_med / exact_med, 3) if exact_med
+            else 0.0,
+            "recall_at_k": round(recall, 4),
+            "recall_floor": args.recall_floor,
+            "planes": int(args.lsh_planes),
+            "candidate_cap": int(cand_cap),
+            "avg_candidates": round(avg_cand, 1),
+            "candidate_fraction": round(avg_cand / n_items, 4),
+            "probed": probed,
+            "fallbacks": fallbacks,
+        },
+        "sharded": sharded_block
+        or {"skipped": f"{ndev} device(s) — needs >= 2"},
+    }
+    print(json.dumps(result))
+
+    if not bit_exact:
+        print("PARITY FAIL: blocked top-K is not bit-identical to the "
+              "stable-argsort baseline", file=sys.stderr)
+        return 1
+    if recall < args.recall_floor:
+        print(f"RECALL FAIL: pruned recall@{k} {recall:.4f} below the "
+              f"{args.recall_floor} floor", file=sys.stderr)
+        return 1
+    if not sharded_ok:
+        print(f"SHARDED PARITY FAIL: {sharded_block}", file=sys.stderr)
+        return 1
+    if args.smoke and probed == 0:
+        print("SMOKE FAIL: no query took the pruned path — the recall "
+              "gate ran vacuously (all fallbacks)", file=sys.stderr)
+        return 1
+    if args.smoke and (steady or any(
+            b["steady_state_recompiles"] for b in sharded_block.values())):
+        print(f"SMOKE FAIL: steady_state_recompiles={steady} "
+              f"sharded={sharded_block}", file=sys.stderr)
         return 1
     return 0
 
@@ -1781,6 +2012,35 @@ def main() -> int:
     ap.add_argument("--parity-tol-score", type=float, default=1e-4,
                     help="max |sharded - single| / max|single| holdout "
                          "score drift a placement may show (hard gate)")
+    ap.add_argument("--topk", action="store_true",
+                    help="top-K retrieval bench (serving/retrieval.py): "
+                         "queries/sec against a blocked-streamed MF "
+                         "catalog; hard-fails unless the blocked merge is "
+                         "bit-identical to the stable-argsort baseline, "
+                         "LSH-pruned recall@K holds --recall-floor, and "
+                         "sharded catalogs match single-device scores")
+    ap.add_argument("--catalog-items", type=int, default=None,
+                    help="items in the MF catalog; default 200000 "
+                         "(2048 under --smoke)")
+    ap.add_argument("--topk-queries", type=int, default=None,
+                    help="distinct user queries per trial; default 512 "
+                         "(24 under --smoke)")
+    ap.add_argument("--topk-k", type=int, default=None,
+                    help="results per query; default 32 (8 under --smoke)")
+    ap.add_argument("--topk-block-items", type=int, default=None,
+                    help="catalog block size of the streamed merge; "
+                         "default 8192 (256 under --smoke)")
+    ap.add_argument("--lsh-planes", type=int, default=None,
+                    help="signed-random-projection planes of the frozen "
+                         "index; default 8 (4 under --smoke)")
+    ap.add_argument("--recall-floor", type=float, default=None,
+                    help="min mean pruned recall@K vs exact scoring "
+                         "(hard gate); default 0.3 (0.5 under --smoke — "
+                         "pinned from the measured smoke-shape recall "
+                         "with margin)")
+    ap.add_argument("--mf-factor", type=int, default=None,
+                    help="MF embedding width; default 32 (8 under "
+                         "--smoke)")
     ap.add_argument("--quant-trials", type=int, default=None,
                     help="paired trials per precision/placement; default 5 "
                          "(3 under --smoke)")
@@ -1867,31 +2127,71 @@ def main() -> int:
                        "concurrency": (0, 2),
                        "max_batch": (1024, 64),
                        "instances_per_request": (1024, 4)})
+    if args.topk:
+        # the retrieval bench sizes for a catalog worth streaming: 200k
+        # items x 32 factors full-scale (the blocked merge sweeps ~25
+        # blocks per query batch), tiny under --smoke where the subject
+        # is the gates (bit-exact parity, recall floor, zero recompiles,
+        # sharded score parity), not bandwidth. The smoke recall floor
+        # (0.5) is pinned from measured smoke-shape recall (~0.7 at 4
+        # planes) with margin; the full-scale floor is looser — at 8
+        # planes the probe touches ~3.5% of the catalog and the
+        # recall/speedup trade is the thing being REPORTED.
+        sizing.update({"catalog_items": (200000, 2048),
+                       "topk_queries": (512, 24),
+                       "topk_k": (32, 8),
+                       "topk_block_items": (8192, 256),
+                       "lsh_planes": (8, 4),
+                       "recall_floor": (0.3, 0.5),
+                       "mf_factor": (32, 8),
+                       "train_rows": (400000, 4000),
+                       "max_batch": (8, 4)})
     for name, (full, small) in sizing.items():
         if getattr(args, name) is None:
             setattr(args, name, small if args.smoke else full)
 
+    if args.topk:
+        if args.artifact or args.http or args.quantize or args.sharded \
+                or args.skew or args.overload:
+            raise SystemExit("--topk trains and freezes its own MF "
+                             "catalog; it does not compose with "
+                             "--artifact, --http, --quantize, --sharded, "
+                             "--skew or --overload")
+        import os
+
+        # the sharded-catalog parity segment needs a mesh: CPU runs force
+        # 8 host devices BEFORE jax initializes (re-exec, the --sharded
+        # pattern); real accelerator runs keep their native device set
+        flags = os.environ.get("XLA_FLAGS", "")
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+                and "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+            os.execv(sys.executable, [sys.executable] + sys.argv)
+        return run_topk_mode(args)
+
     if args.overload:
         if args.artifact or args.http or args.quantize or args.sharded \
-                or args.skew:
+                or args.skew or args.topk:
             raise SystemExit("--overload trains and deploys its own model; "
                              "it does not compose with --artifact, --http, "
-                             "--quantize, --sharded or --skew")
+                             "--quantize, --sharded, --skew or --topk")
         return run_overload_mode(args)
 
     if args.skew:
-        if args.artifact or args.http or args.quantize or args.sharded:
+        if args.artifact or args.http or args.quantize or args.sharded \
+                or args.topk:
             raise SystemExit("--skew trains and deploys its own model "
                              "twice (cache-on / cache-off); it does not "
-                             "compose with --artifact, --http, --quantize "
-                             "or --sharded")
+                             "compose with --artifact, --http, --quantize, "
+                             "--sharded or --topk")
         return run_skew_mode(args)
 
     if args.sharded:
-        if args.artifact or args.http or args.quantize:
+        if args.artifact or args.http or args.quantize or args.topk:
             raise SystemExit("--sharded trains and places its own model; "
-                             "it does not compose with --artifact, --http "
-                             "or --quantize")
+                             "it does not compose with --artifact, --http, "
+                             "--quantize or --topk")
         import os
 
         # CPU runs simulate a mesh the same way the test suite does
@@ -1909,10 +2209,10 @@ def main() -> int:
         return run_sharded_mode(args)
 
     if args.quantize:
-        if args.artifact or args.http:
+        if args.artifact or args.http or args.topk:
             raise SystemExit("--quantize freezes its own model at three "
                              "precisions; it does not compose with "
-                             "--artifact or --http")
+                             "--artifact, --http or --topk")
         import os
 
         # serving-shaped XLA threading: production servers give each

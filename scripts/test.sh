@@ -147,7 +147,18 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --skew --smoke
 
-# tier-1 gate 11: native sanitizer pass — the parity/refusal suites run
+# tier-1 gate 11: top-K retrieval smoke — the blocked streamed top-K
+# merge over an MF catalog must be BIT-identical (ids and f32 scores) to
+# the stable-argsort baseline, the LSH-pruned path must hold the pinned
+# recall@K floor with at least one query actually pruned, sharded
+# catalogs must reproduce single-device scores at equal model, and the
+# whole sweep — exact and probed, every bucket — must run with zero
+# steady-state recompiles (docs/serving.md "Top-K retrieval"; prints one
+# BENCH-style JSON line)
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_serving.py --topk --smoke
+
+# tier-1 gate 12: native sanitizer pass — the parity/refusal suites run
 # against the ASan+UBSan-instrumented .so (halt_on_error: any heap
 # overflow, use-after-free, or UB aborts the run). This is the dynamic
 # complement to graftcheck's G022-G026 static FFI rules, and the harness

@@ -430,8 +430,11 @@ def test_engine_row_keys_canonical_across_request_forms():
     assert eng.row_keys([["7:1.0", "3:0.5"]]) != k_str
 
 
-def test_row_keys_unsupported_family_is_none():
+def test_row_keys_trees_hash_binned_row():
+    """Tree keys hash the BINNED row: two raw rows that land in the same
+    bins share one cache line; malformed shapes are uncacheable."""
     from hivemall_tpu.models.trees import train_randomforest_classifier
+    from hivemall_tpu.models.trees.binning import bin_data
     from hivemall_tpu.serving import ServingEngine
 
     rng = np.random.RandomState(3)
@@ -439,7 +442,57 @@ def test_row_keys_unsupported_family_is_none():
     y = (X[:, 0] > 0.5).astype(int)
     model = train_randomforest_classifier(X, y, "-trees 2 -seed 1")
     eng = ServingEngine(model, name="rk_tree", max_batch=16)
-    assert eng.row_keys([list(X[0])]) is None
+    keys = eng.row_keys([list(X[0]), list(X[1])])
+    assert keys is not None and len(keys) == 2 and keys[0] != keys[1]
+    # a perturbation too small to cross a bin edge keys identically
+    sv = eng.servable
+    eps = np.full(4, 1e-12)
+    same_bins = np.array_equal(
+        bin_data(np.asarray([X[0]], sv.stage_dtype), sv.bins),
+        bin_data(np.asarray([X[0] + eps], sv.stage_dtype), sv.bins))
+    if same_bins:
+        assert eng.row_keys([list(X[0] + eps)]) == [keys[0]]
+    # ragged input: uncacheable, the shape error surfaces on predict
+    assert eng.row_keys([[0.1, 0.2]]) is None
+    # end to end: with a cache enabled the second identical request is
+    # all hits and scores match — the cache now covers the tree families
+    reg = ModelRegistry(score_cache_bytes=1 << 20,
+                        engine_kwargs={"max_batch": 16})
+    reg.deploy("rk_tree_e2e", model, version="1")
+    rows = [list(x) for x in X[:4]]
+    a = reg.submit("rk_tree_e2e", rows)[1].result(10)
+    b = reg.submit("rk_tree_e2e", rows)[1].result(10)
+    st = reg.get("rk_tree_e2e").describe()["cache"]
+    assert st["hit_rows"] == 4 and st["miss_rows"] == 4
+    assert np.allclose(np.asarray(a, float), np.asarray(b, float))
+    reg.shutdown()
+
+
+def test_row_keys_ffm_normalized_triples():
+    """FFM keys hash the normalized (field, id, value) triples — the
+    written form doesn't matter, the parsed canonical form does."""
+    from hivemall_tpu.models.ffm import train_ffm
+    from hivemall_tpu.serving import ServingEngine
+
+    rows = [[f"{i % 3}:{i % 7}:1.0", f"{(i + 1) % 3}:{(i * 5) % 7}:0.5"]
+            for i in range(30)]
+    labels = [1 if i % 2 else -1 for i in range(30)]
+    model = train_ffm(rows, labels, "-factor 2 -iters 2 -feature_hashing 5"
+                                    " -num_fields 3")
+    eng = ServingEngine(model, name="rk_ffm", max_batch=16, max_width=8)
+    keys = eng.row_keys(rows[:2])
+    assert keys is not None and len(keys) == 2 and keys[0] != keys[1]
+    assert eng.row_keys(rows[:2]) == keys  # deterministic
+    # ids hash mod num_features: a row written with the wrapped id is the
+    # same canonical triple, hence the same key
+    nf = model.hyper.num_features
+    assert eng.row_keys([[f"1:{3 + nf}:1.0"]]) == \
+        eng.row_keys([[f"1:3:1.0"]])
+    # over-wide rows make the request uncacheable (truncation lives in
+    # staging); unparseable rows too
+    wide = [[f"1:{k}:1.0" for k in range(9)]]
+    assert eng.row_keys(wide) is None
+    assert eng.row_keys([["not-a-feature::"]]) is None
 
 
 def test_metrics_and_models_surface():
