@@ -88,7 +88,11 @@ def main(argv: List[str] = None) -> int:
                     "G022-G026, and exception-flow / failure-path safety "
                     "G027-G031: future leaks, silent fallbacks, swallowed "
                     "exceptions, unwind-unsafe locking, unbounded retries "
-                    "— with a --fix autofix engine and SARIF output)")
+                    "— jit-cache / retrace-hazard traceflow G032-G036: "
+                    "cache-entry churn, host branches on traced values, "
+                    "unbucketed shape dispatch, donated-buffer reuse, "
+                    "hot-loop host syncs — with a --fix autofix engine "
+                    "and SARIF output)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories (default: hivemall_tpu)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,

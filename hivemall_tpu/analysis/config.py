@@ -258,3 +258,49 @@ SIDE_EFFECT_CALLS = ("print",)
 SIDE_EFFECT_ATTR_ROOTS = ("time", "logging")
 SIDE_EFFECT_METHODS = ("increment", "set_gauge", "record")
 SIDE_EFFECT_NP_RANDOM = ("random",)
+
+# --- v7 traceflow (G032-G036) ----------------------------------------------
+# Modules whose jit call graphs the trace-time rules sweep by default: the
+# serving dispatch stack and the kernel/op layers every jitted scorer and
+# step funnels through. The zero-recompile contract is a property of these
+# modules first; anything else opts in with the marker comment.
+TRACEFLOW_HOT_PREFIXES = (
+    "hivemall_tpu/ops/",
+    "hivemall_tpu/kernels/",
+)
+TRACEFLOW_HOT_MODULES = (
+    "hivemall_tpu/serving/engine.py",
+    "hivemall_tpu/serving/retrieval.py",
+    "hivemall_tpu/serving/sharded.py",
+)
+TRACEFLOW_MARKER = "# graftcheck: jit-hot-module"
+
+# Module-level dicts recognized as sanctioned jit memos (the _SHARDED_JIT /
+# _RETRIEVAL_JIT / _QUANT_JIT get-or-build idiom): a function that both
+# reads and writes one of these is a memo helper, and jit wrappers built
+# under it are constructed once per key, not once per call.
+TRACEFLOW_MEMO_NAME_RE = re.compile(r"^_[A-Z0-9_]*JIT[A-Z0-9_]*$")
+
+# Function names sanctioned to construct jit wrappers per CALL of the
+# factory: builders invoked once at setup (make_*/build_*) and __init__.
+# Calling one of these per hot-loop iteration is still churn (G032c).
+TRACEFLOW_FACTORY_RE = re.compile(r"^_?(make|build)_\w+")
+
+# Calls that canonicalize an array's shape onto the bucket ladder before it
+# reaches a jitted callable (G034). pad_to_bucket is the width calculator
+# (slicing/padding to its result IS bucket routing); bucket_rows and
+# pad_rows_to_multiple are the array-level canonicalizers; a bare pad is
+# trusted as deliberate shape control.
+SHAPE_CANONICALIZERS = ("pad_to_bucket", "bucket_rows", "pad_rows_to_multiple",
+                        "pad")
+
+# Callee names that declare themselves host-sync boundaries (G036): a
+# helper named like one of these performs its device_get on purpose, as the
+# loop's sanctioned whole-value boundary read.
+TRACEFLOW_SYNC_NAME_RE = re.compile(
+    r"(sync|block_until|device_get|to_host|fetch|drain|gather_host)",
+    re.IGNORECASE)
+
+# Call tails inside a callee body that constitute an unconditional device
+# sync for the G036 summary walk (taint-free: these block by name).
+TRACEFLOW_SYNC_CALL_TAILS = ("device_get", "block_until_ready")

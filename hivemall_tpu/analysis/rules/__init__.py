@@ -22,7 +22,9 @@ from . import (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
                g025_ffi_abi_drift, g026_ffi_unchecked_return,
                g027_future_leak, g028_silent_fallback,
                g029_swallowed_exception, g030_unwind_under_lock,
-               g031_unbounded_retry)
+               g031_unbounded_retry, g032_jit_cache_churn,
+               g033_host_branch_traced, g034_unbucketed_shape,
+               g035_donated_reuse, g036_hot_loop_sync)
 
 _MODULE_RULES = (g001_recompile, g002_host_sync, g003_dtype, g004_axis,
                  g005_donation, g006_side_effect, g009_api_compat,
@@ -37,7 +39,10 @@ _PROGRAM_RULES = (g007_collective_axis, g008_spec_mesh,
                   g024_ffi_missing_prototype, g025_ffi_abi_drift,
                   g026_ffi_unchecked_return, g027_future_leak,
                   g028_silent_fallback, g029_swallowed_exception,
-                  g030_unwind_under_lock, g031_unbounded_retry)
+                  g030_unwind_under_lock, g031_unbounded_retry,
+                  g032_jit_cache_churn, g033_host_branch_traced,
+                  g034_unbucketed_shape, g035_donated_reuse,
+                  g036_hot_loop_sync)
 
 ALL_RULES: Dict[str, Callable[[ModuleModel], List[Finding]]] = {
     m.RULE_ID: m.check for m in _MODULE_RULES

@@ -1,0 +1,105 @@
+"""G035 donated-buffer-use-after-call: the loop-carried and cross-module gap.
+
+G005(b) catches straight-line reads after a donating jit call, but only
+when the donating alias is declared in the same module (``name =
+jax.jit(fn, donate_argnums=...)``) and only lexically *after* the call.
+Two live classes escape it:
+
+(a) **loop-carried reuse**: a donating call inside a loop whose donated
+    name is never rebound anywhere in the loop body — iteration 1 hands
+    the buffer to XLA, iteration 2 passes a deleted array. The sanctioned
+    carry rebinds the result (``cv, ci = self._step(..., cv, ci)``, the
+    retrieval top-K idiom); a loop that donates the same binding every
+    pass is flagged.
+(b) **interprocedurally-donating callees**: ``self._step =
+    self._build_block_step()`` where the factory ``return``s
+    ``jax.jit(step, donate_argnums=...)`` — or the memo-thunk form
+    ``self._step = _retrieval_jit(key, lambda: _build_step())``. G005's
+    alias map cannot see these; traceflow resolves them, and this rule
+    runs G005's straight-line scan over exactly the resolved-only aliases
+    (module-local aliases stay G005's subject — no double findings).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..findings import Finding, Severity
+from ..modmodel import walk_scope
+from ..program import ProgramModel
+from ..traceflow import module_info
+from .g005_donation import _assigned_names, _donated_name, _scan_block, \
+    _target_names
+
+RULE_ID = "G035"
+
+
+def _loop_assigned(loop) -> Set[str]:
+    out: Set[str] = set()
+    if isinstance(loop, ast.For):
+        out.update(_target_names(loop.target))
+    for stmt in loop.body:
+        out.update(_assigned_names(stmt))
+    return out
+
+
+def check_program(program: ProgramModel, scanned: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+
+    for path in sorted(scanned):
+        model = program.modules.get(path)
+        if model is None:
+            continue
+        info = module_info(model)
+        # module aliases + interprocedurally-resolved ones (pattern a
+        # needs both: the loop-carry gap exists for either kind)
+        donating: Dict[str, object] = {
+            name: wrap for name, wrap in model.jit_aliases.items()
+            if wrap.donate_argnums}
+        donating.update(info.donating)
+
+        def emit(node: ast.AST, msg: str, sev: str) -> None:
+            if (path, node.lineno) in seen:
+                return
+            seen.add((path, node.lineno))
+            findings.append(Finding(path, node.lineno, RULE_ID, sev, msg,
+                                    model.snippet(node.lineno)))
+
+        if not donating:
+            continue
+        for fn in model.functions:
+            if model.is_traced(fn):
+                continue
+            # (a) loop-carried donation without a rebind in the loop body
+            loops_checked: Set[Tuple[int, str]] = set()
+            for node in walk_scope(fn):
+                if not isinstance(node, (ast.For, ast.While)):
+                    continue
+                rebound = None
+                for call in ast.walk(node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    victim = _donated_name(call, donating)
+                    if victim is None:
+                        continue
+                    if rebound is None:
+                        rebound = _loop_assigned(node)
+                    if victim in rebound:
+                        continue
+                    key = (node.lineno, victim)
+                    if key in loops_checked:
+                        continue
+                    loops_checked.add(key)
+                    emit(call,
+                         f"`{victim}` is donated to a jitted call every "
+                         f"iteration but never rebound in the loop body — "
+                         f"iteration 2 passes a buffer XLA already owns "
+                         f"(deleted-array error); carry the result "
+                         f"(`{victim} = step(..., {victim})`) or drop "
+                         f"donation", Severity.ERROR)
+            # (b) straight-line scan over the resolved-only aliases
+            if info.donating:
+                _scan_block(model, fn, list(fn.body), info.donating, emit)
+    return findings

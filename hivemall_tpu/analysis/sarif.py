@@ -28,7 +28,7 @@ from .findings import Finding, Severity
 SARIF_VERSION = "2.1.0"
 SARIF_SCHEMA = ("https://docs.oasis-open.org/sarif/sarif/v2.1.0/errata01/"
                 "os/schemas/sarif-schema-2.1.0.json")
-TOOL_VERSION = "6.0"
+TOOL_VERSION = "7.0"
 INFO_URI = "https://github.com/hivemall-tpu/hivemall-tpu" \
            "/blob/main/docs/static_analysis.md"
 

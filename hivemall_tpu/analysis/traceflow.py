@@ -1,0 +1,441 @@
+"""Trace-time / jit-cache model: the v7 layer behind G032-G036.
+
+The repo's one dynamic invariant — zero steady-state recompiles, witnessed
+after the fact by ``recompile_guard`` counters — rests on three static
+properties this module makes provable, stdlib-only and jax-free, on top of
+the per-module models (modmodel.py) and the whole-program layer
+(program.py):
+
+- **jit cache identity**: which ``jax.jit(...)`` call sites produce a
+  wrapper whose compile cache survives across calls. A module-level def
+  wrapped once shares one cache forever; a fresh lambda / closure (nested
+  def) / ``partial`` object reaching ``jax.jit`` per call never hits its
+  own cache again (measured: three ``jax.jit(nested_def)`` wrappers at one
+  shape compile three times, while a cache-size probe on any *named*
+  wrapper stays flat — the counter blind spot the dynamic attribution in
+  runtime/metrics.py closes). Every site is classified by the wrapped
+  expression's identity class and by its construction context;
+- **sanctioned memo plumbing**: the ``_SHARDED_JIT`` / ``_RETRIEVAL_JIT``
+  / ``_QUANT_JIT`` get-or-build idiom — a module-level dict named like a
+  jit memo, both read and written by a helper function — bounds wrapper
+  construction to once per key. Jit sites under a memo helper, under a
+  ``make_*``/``build_*`` factory, under ``__init__``, at module level, or
+  in a decorator position are construction-once by convention and never
+  churn findings;
+- **shape canonicalization**: which call-site arguments are routed through
+  the bucket ladder (``pad_to_bucket`` widths, ``bucket_rows`` /
+  ``pad_rows_to_multiple`` array padding) before reaching a jitted
+  callable — the recompile-per-novel-shape hazard the serving warmup
+  matrix exists to prevent;
+- **donation flow**: jit aliases with ``donate_argnums`` resolved
+  *interprocedurally* — through ``self._step = self._build_block_step()``
+  factory assignments and through memo-helper build thunks — so
+  use-after-donate is provable beyond the single-module straight-line scan
+  G005 already does (loop-carried donations are the live case:
+  retrieval.py's top-K carries donate the running best buffers every
+  block).
+
+Resolution is deliberately conservative, like every layer before it: the
+rules flag only what the model proves (a fresh-identity object reaching a
+jit site outside every sanctioned context; a slice with a non-literal
+bound reaching a provably-jitted callee), and anything dynamic is trusted.
+
+Per-module facts are memoized as ``model._graftcheck_traceflow`` (the
+``_graftcheck_*`` prefix is stripped by modelcache before pickling); the
+program-level handle follows the exceptionflow/concurrency pattern via
+``get_info``/``program._graftcheck_traceflow``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import config
+from .modmodel import (_FN_TYPES, JitWrap, ModuleModel, dotted_name,
+                       enclosing_loop, walk_scope)
+from .program import ProgramModel
+
+SYNC_WALK_DEPTH = 3
+
+
+# --------------------------------------------------------------------------
+# jit call-site classification
+# --------------------------------------------------------------------------
+
+class JitSite:
+    """One ``jax.jit(...)`` call: what identity class the wrapped
+    expression has, and whether the construction context is sanctioned."""
+
+    __slots__ = ("call", "wrap", "arg_kind", "wrapped_name", "in_loop",
+                 "sanctioned", "eta_target")
+
+    def __init__(self, call: ast.Call):
+        self.call = call
+        self.wrap = JitWrap(call)
+        self.in_loop = enclosing_loop(call) is not None
+        self.sanctioned = False
+        self.arg_kind = "none"          # none|lambda|closure|partial|named
+        self.wrapped_name: Optional[str] = None
+        self.eta_target: Optional[ast.expr] = None
+
+
+def _eta_target(lam: ast.Lambda) -> Optional[ast.expr]:
+    """``lambda x, y: f(x, y)`` -> the ``f`` expression; None when the
+    lambda is not a pure eta-expansion (defaults, kwargs, reordered or
+    transformed arguments all disqualify)."""
+    a = lam.args
+    if a.defaults or a.kw_defaults or a.kwonlyargs or a.vararg or a.kwarg:
+        return None
+    params = [p.arg for p in a.posonlyargs + a.args]
+    body = lam.body
+    if not isinstance(body, ast.Call) or body.keywords:
+        return None
+    if not isinstance(body.func, (ast.Name, ast.Attribute)):
+        return None
+    if isinstance(body.func, ast.Name) and body.func.id in params:
+        return None
+    if len(body.args) != len(params):
+        return None
+    for arg, param in zip(body.args, params):
+        if not (isinstance(arg, ast.Name) and arg.id == param):
+            return None
+    return body.func
+
+
+class ModuleTraceInfo:
+    """Per-module trace-time facts, memoized on the ModuleModel."""
+
+    __slots__ = ("memo_dicts", "memo_helper_fns", "memo_helper_names",
+                 "sites", "donating")
+
+    def __init__(self, model: ModuleModel):
+        self.memo_dicts = _memo_dicts(model)
+        self.memo_helper_fns: Set[ast.AST] = set()
+        self.memo_helper_names: Set[str] = set()
+        for fn in model.functions:
+            if _touches_memo(fn, self.memo_dicts) or _is_cached(fn):
+                self.memo_helper_fns.add(fn)
+                self.memo_helper_names.add(fn.name)
+        # one tree walk feeds both site classification and donating-alias
+        # resolution — this constructor runs for every module in the
+        # program context, so the walk count is the scan's hot dimension
+        jit_calls: List[ast.Call] = []
+        call_assigns: List[ast.Assign] = []
+        for node in ast.walk(model.tree):
+            if isinstance(node, ast.Call):
+                if dotted_name(node.func) in ("jax.jit", "jit"):
+                    jit_calls.append(node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                call_assigns.append(node)
+        self.sites = _collect_sites(model, jit_calls, self.memo_helper_fns,
+                                    self.memo_helper_names)
+        self.donating = _donating_map(model, call_assigns,
+                                      self.memo_helper_names)
+
+
+def _memo_dicts(model: ModuleModel) -> Set[str]:
+    out: Set[str] = set()
+    for node in model.tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) \
+                        and config.TRACEFLOW_MEMO_NAME_RE.match(tgt.id):
+                    out.add(tgt.id)
+    return out
+
+
+def _touches_memo(fn: ast.AST, memo_names: Set[str]) -> bool:
+    """A memo helper both reads (get/subscript-load/truth-test/``in``) and
+    writes (subscript-store/setdefault/update) a module-level jit memo —
+    the get-or-build contract that bounds wrappers to one per key."""
+    if not memo_names:
+        return False
+    reads: Set[str] = set()
+    writes: Set[str] = set()
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in memo_names:
+            bucket = writes if isinstance(node.ctx, ast.Store) else reads
+            bucket.add(node.value.id)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in memo_names:
+            if node.func.attr in ("setdefault", "update"):
+                writes.add(node.func.value.id)
+            elif node.func.attr in ("get", "pop"):
+                reads.add(node.func.value.id)
+        elif isinstance(node, (ast.If, ast.While)):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Name) and sub.id in memo_names:
+                    reads.add(sub.id)
+        elif isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)) \
+                        and isinstance(comp, ast.Name) \
+                        and comp.id in memo_names:
+                    reads.add(comp.id)
+    return bool(reads & writes)
+
+
+def _is_cached(fn: ast.AST) -> bool:
+    """``functools.lru_cache`` / ``functools.cache`` decorated functions
+    are memo helpers by construction — one return value per distinct key,
+    forever — so a jit wrapper built inside one is construction-once
+    (grow.py's ``_sharded_hist_fn`` is the live case)."""
+    for dec in getattr(fn, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name is not None \
+                and name.rsplit(".", 1)[-1] in ("lru_cache", "cache"):
+            return True
+    return False
+
+
+def local_rebinds(fn: ast.AST) -> Set[str]:
+    """Names (re)bound by assignment or loop target inside ``fn``. A local
+    binding shadows any same-named def, so a bare call to one of these
+    must not be resolved lexically (``predict = make_predict(...)`` inside
+    a ``predict`` method is the live case)."""
+    out: Set[str] = set()
+    for node in walk_scope(fn):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            targets = [node.target]
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            targets = [node.optional_vars]
+        else:
+            continue
+        for tgt in targets:
+            for sub in ast.walk(tgt):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def _is_decorator_of(call: ast.Call, fn: Optional[ast.AST]) -> bool:
+    if fn is None:
+        return False
+    for dec in getattr(fn, "decorator_list", ()):
+        for node in ast.walk(dec):
+            if node is call:
+                return True
+    return False
+
+
+def _context_sanctioned(model: ModuleModel, call: ast.Call,
+                        memo_helper_fns: Set[ast.AST],
+                        memo_helper_names: Set[str]) -> bool:
+    """Construction-once contexts: module level, decorators, __init__,
+    make_*/build_* factories, memo helpers (at any enclosing depth), and
+    build thunks passed as arguments to a memo-helper call."""
+    fn = model.enclosing_function(call)
+    if fn is None or _is_decorator_of(call, fn):
+        return True
+    cur = fn
+    while cur is not None:
+        if cur.name == "__init__" \
+                or config.TRACEFLOW_FACTORY_RE.match(cur.name) \
+                or cur in memo_helper_fns:
+            return True
+        cur = model.enclosing_function(cur)
+    # lexically inside an argument of a memo-helper call (the
+    # `_retrieval_jit(key, lambda: jax.jit(...))` thunk shape)
+    node: ast.AST = call
+    while node is not None and not isinstance(node, _FN_TYPES):
+        parent = getattr(node, "graftcheck_parent", None)
+        if isinstance(parent, ast.Call) and parent is not call:
+            callee = dotted_name(parent.func)
+            if callee is not None \
+                    and callee.rsplit(".", 1)[-1] in memo_helper_names:
+                return True
+        node = parent
+    return False
+
+
+def _collect_sites(model: ModuleModel, jit_calls: List[ast.Call],
+                   memo_helper_fns: Set[ast.AST],
+                   memo_helper_names: Set[str]) -> List[JitSite]:
+    sites: List[JitSite] = []
+    for node in jit_calls:
+        site = JitSite(node)
+        site.sanctioned = _context_sanctioned(model, node, memo_helper_fns,
+                                              memo_helper_names)
+        fn_arg = node.args[0] if node.args else None
+        if fn_arg is None:
+            site.arg_kind = "none"
+        elif isinstance(fn_arg, ast.Lambda):
+            site.arg_kind = "lambda"
+            site.eta_target = _eta_target(fn_arg)
+        elif isinstance(fn_arg, ast.Call):
+            callee = dotted_name(fn_arg.func)
+            site.arg_kind = "partial" \
+                if callee in ("partial", "functools.partial") else "named"
+            site.wrapped_name = callee
+        elif isinstance(fn_arg, ast.Name):
+            site.wrapped_name = fn_arg.id
+            target = model.resolve_def(fn_arg.id, node)
+            if target is not None \
+                    and model.enclosing_function(target) is not None:
+                # a nested def is a fresh closure object per enclosing call
+                site.arg_kind = "closure"
+            else:
+                site.arg_kind = "named"
+        else:
+            site.wrapped_name = dotted_name(fn_arg)
+            site.arg_kind = "named"
+        sites.append(site)
+    return sites
+
+
+# --------------------------------------------------------------------------
+# interprocedural donating-alias resolution
+# --------------------------------------------------------------------------
+
+def _thunk_factory_name(value: ast.Call,
+                        memo_helper_names: Set[str]) -> Optional[str]:
+    """``_retrieval_jit(key, lambda: self._build_x(...))`` -> "_build_x"
+    when the callee is a memo helper and an argument is a build thunk."""
+    callee = dotted_name(value.func)
+    if callee is None or callee.rsplit(".", 1)[-1] not in memo_helper_names:
+        return None
+    for arg in list(value.args) + [kw.value for kw in value.keywords]:
+        if isinstance(arg, ast.Lambda) and isinstance(arg.body, ast.Call):
+            inner = dotted_name(arg.body.func)
+            if inner is not None:
+                return inner.rsplit(".", 1)[-1]
+        elif isinstance(arg, ast.Name):
+            return arg.id
+    return None
+
+
+def _donating_map(model: ModuleModel, call_assigns: List[ast.Assign],
+                  memo_helper_names: Set[str]) -> Dict[str, JitWrap]:
+    """Donating callables G005's module-local alias map cannot see:
+    ``self.X = <factory>()`` / ``self.X = <memo helper>(key, thunk)``
+    where the factory's returned jit (recorded in jit_aliases under the
+    factory's name) has donate_argnums."""
+    out: Dict[str, JitWrap] = {}
+    for node in call_assigns:
+        tgt = node.targets[0]
+        tgt_name = dotted_name(tgt)
+        if tgt_name is None or tgt_name in model.jit_aliases:
+            continue
+        value = node.value
+        callee = dotted_name(value.func)
+        factory = None
+        if callee is not None:
+            tail = callee.rsplit(".", 1)[-1]
+            if tail in memo_helper_names:
+                factory = _thunk_factory_name(value, memo_helper_names)
+            elif tail in model.jit_aliases:
+                factory = tail
+        if factory is None:
+            continue
+        wrap = model.jit_aliases.get(factory)
+        if wrap is not None and wrap.donate_argnums:
+            out[tgt_name] = wrap
+    return out
+
+
+# --------------------------------------------------------------------------
+# memoized accessors
+# --------------------------------------------------------------------------
+
+def module_info(model: ModuleModel) -> ModuleTraceInfo:
+    info = getattr(model, "_graftcheck_traceflow", None)
+    if info is None:
+        info = ModuleTraceInfo(model)
+        model._graftcheck_traceflow = info  # type: ignore[attr-defined]
+    return info
+
+
+class TraceflowModel:
+    """Program-level handle shared by the five v7 rules."""
+
+    def __init__(self, program: ProgramModel):
+        self.program = program
+
+    def info(self, path: str) -> Optional[ModuleTraceInfo]:
+        model = self.program.modules.get(path)
+        return module_info(model) if model is not None else None
+
+    # -- G032c: does a resolvable callee construct jit wrappers? ----------
+
+    def jit_site_in(self, path: str, fn: ast.AST) -> Optional[JitSite]:
+        """First jit site lexically within ``fn`` (nested defs included) —
+        the evidence that calling ``fn`` per iteration churns wrappers."""
+        model = self.program.modules.get(path)
+        info = self.info(path)
+        if model is None or info is None:
+            return None
+        for site in info.sites:
+            cur = model.enclosing_function(site.call)
+            while cur is not None:
+                if cur is fn:
+                    return site
+                cur = model.enclosing_function(cur)
+        return None
+
+    # -- G036: depth-bounded callee sync summaries ------------------------
+
+    def sync_site(self, path: str, fn: ast.AST, depth: int = 0
+                  ) -> Optional[Tuple[str, int, str]]:
+        """(module, line, call tail) of the first provable device sync a
+        call to ``fn`` performs — ``jax.device_get`` /
+        ``.block_until_ready()`` in its own scope or in a resolvable bare
+        callee, depth-bounded. Taint-free by design: only calls that block
+        *by name* count, so already-host values can never false-positive."""
+        model = self.program.modules.get(path)
+        if model is None or depth > SYNC_WALK_DEPTH:
+            return None
+        memo: Dict[int, object] = getattr(model, "_graftcheck_syncs", None)
+        if memo is None:
+            memo = {}
+            model._graftcheck_syncs = memo  # type: ignore[attr-defined]
+        key = id(fn)
+        if key in memo:
+            cached = memo[key]
+            return cached if cached != () else None  # type: ignore[return-value]
+        memo[key] = ()  # cycle guard: in-progress reads as "no sync"
+        result: Optional[Tuple[str, int, str]] = None
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee is None:
+                continue
+            tail = callee.rsplit(".", 1)[-1]
+            if tail in config.TRACEFLOW_SYNC_CALL_TAILS:
+                result = (path, node.lineno, tail)
+                break
+            if "." not in callee:
+                got = self.program.resolve_fn(path, callee, node)
+                if got is not None:
+                    deeper = self.sync_site(got[0], got[1], depth + 1)
+                    if deeper is not None:
+                        result = deeper
+                        break
+        memo[key] = result if result is not None else ()
+        return result
+
+
+def get_model(program: ProgramModel) -> TraceflowModel:
+    model = getattr(program, "_graftcheck_traceflow", None)
+    if model is None:
+        model = TraceflowModel(program)
+        program._graftcheck_traceflow = model  # type: ignore[attr-defined]
+    return model
+
+
+def in_traceflow_scope(path: str, model: Optional[ModuleModel]) -> bool:
+    """G034/G036 sweep the jit-hot scope: the kernel/op layers, the
+    serving dispatch modules, and anything opting in with the marker."""
+    if path.startswith(config.TRACEFLOW_HOT_PREFIXES) \
+            or path in config.TRACEFLOW_HOT_MODULES:
+        return True
+    return model is not None and config.TRACEFLOW_MARKER in model.source

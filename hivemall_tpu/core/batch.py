@@ -47,6 +47,21 @@ def pad_to_bucket(k: int, min_width: int = 8) -> int:
     return w
 
 
+def bucket_rows(x, min_rows: int = 8):
+    """Pad an array's leading (row) axis up to the bucket ladder
+    (``pad_to_bucket``): the shape canonicalizer for feeding a
+    variable-length batch to a jitted callable without forking one compile
+    per novel length (graftcheck G034 rewrites unrouted dispatch sites to
+    ``scorer(bucket_rows(batch))[:batch.shape[0]]``). Pad rows are zeros —
+    callers slice the result back to the true row count."""
+    n = x.shape[0]
+    b = pad_to_bucket(max(n, 1), min_width=min_rows)
+    if b == n:
+        return x
+    pad_shape = (b - n,) + tuple(x.shape[1:])
+    return np.concatenate([np.asarray(x), np.zeros(pad_shape, x.dtype)])
+
+
 def pack_rows(
     idx_rows: Sequence[np.ndarray],
     val_rows: Sequence[np.ndarray],

@@ -16,6 +16,8 @@ the JAX profiler so kernels show up in xprof/TensorBoard.
 from __future__ import annotations
 
 import contextlib
+import logging
+import re
 import threading
 import time
 from collections import defaultdict
@@ -249,6 +251,43 @@ def _jit_cache_size(fn) -> int:
         return 0
 
 
+# jax 0.4.x logs every XLA compile at DEBUG as "Compiling <fn> with global
+# shapes and types [ShapedArray(...)]. Argument mapping: ...". The capture
+# anchors on the sentence structure, NOT a bracket match — shapes like
+# float32[4] contain `]`, so a lazy `\[.*?\]` truncates mid-list.
+_COMPILE_LOG_RE = re.compile(
+    r"Compiling (\S+) with global shapes and types (.*?)\. Argument mapping")
+
+# the module that owns the "Compiling ..." log line; if a future jax moves
+# it, attribution degrades to empty (counters are unaffected)
+_COMPILE_LOGGER = "jax._src.interpreters.pxla"
+
+# fn name -> shape signature of its LAST compile, process-wide: lets a later
+# guard label a recompile as a shape delta vs a fresh-identity churn
+_LAST_COMPILED_SHAPES: Dict[str, str] = {}
+
+
+class _CompileLogCapture(logging.Handler):
+    """DEBUG tap on the ``jax`` logger: names the function being compiled
+    and the abstract shapes that missed the cache — attribution a
+    cache-size probe cannot give. A fresh ``jax.jit`` wrapper built per
+    call compiles every iteration while every *named* probe stays flat
+    (G032's counter blind spot); the compile log still names the wrapped
+    function each time."""
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.events: list = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            m = _COMPILE_LOG_RE.search(record.getMessage())
+        except Exception:  # graftcheck: disable=G028,G029 (a malformed log record must never break the guarded step; nothing to degrade to — the event is simply not attributed)
+            return
+        if m:
+            self.events.append((m.group(1), m.group(2)))
+
+
 class recompile_guard:
     """Count jit cache misses per named step function — the runtime witness
     for graftcheck's G001 recompile-hazard rule (hivemall_tpu/analysis).
@@ -274,6 +313,16 @@ class recompile_guard:
     with steps (the recompilation-count production metric of the ads-infra
     paper, PAPERS.md). ``expect_stable=True`` raises on any miss — used by
     tests and scripts/profile_step.py to pin the steady state.
+
+    Every guard also taps the jax compile log (``_CompileLogCapture``) and
+    records one attribution per compile in ``guard.attributions``:
+    ``{"fn": <jitted fn name>, "shapes": <abstract arg shapes>, "prev":
+    <that fn's previous shapes or None>, "delta": <bool>}``. This closes
+    the counter's blind spot — a fresh wrapper identity (G032) compiles
+    per call while every named probe stays flat, but the log still names
+    the function — and lets the static finding and the live counter point
+    at the same line. Each attribution is also emitted as a
+    ``jit_retrace_attrib`` trace instant next to ``jit_recompile``.
     """
 
     def __init__(self, name: str, *jitted_fns, registry: "MetricsRegistry" = None,
@@ -283,7 +332,10 @@ class recompile_guard:
         self.registry = registry if registry is not None else REGISTRY
         self.expect_stable = expect_stable
         self.compiles = 0
+        self.attributions: list = []
         self._start: list = []
+        self._log_tap: Optional[_CompileLogCapture] = None
+        self._prior_level = logging.NOTSET
 
     def __enter__(self) -> "recompile_guard":
         if self.expect_stable and self.fns and not any(
@@ -296,30 +348,63 @@ class recompile_guard:
                 f"of the guarded functions expose a jit cache-size probe "
                 f"(_cache_size) — pass jax.jit products")
         self._start = [_jit_cache_size(f) for f in self.fns]
+        self._log_tap = _CompileLogCapture()
+        logger = logging.getLogger(_COMPILE_LOGGER)
+        self._prior_level = logger.level
+        self._prior_propagate = logger.propagate
+        logger.addHandler(self._log_tap)
+        if logger.getEffectiveLevel() > logging.DEBUG:
+            # debug logging is off: lower just the compile logger and stop
+            # propagation so the capture stays silent on the console; when
+            # the user already runs jax at DEBUG, touch nothing
+            logger.setLevel(logging.DEBUG)
+            logger.propagate = False
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        logger = logging.getLogger(_COMPILE_LOGGER)
+        logger.removeHandler(self._log_tap)
+        logger.setLevel(self._prior_level)
+        logger.propagate = self._prior_propagate
+        for fn_name, shapes in self._log_tap.events:
+            prev = _LAST_COMPILED_SHAPES.get(fn_name)
+            _LAST_COMPILED_SHAPES[fn_name] = shapes
+            self.attributions.append({
+                "fn": fn_name, "shapes": shapes, "prev": prev,
+                "delta": prev is not None and prev != shapes})
         sizes = [_jit_cache_size(f) for f in self.fns]
         self.compiles = sum(max(0, now - was)
                             for was, now in zip(self._start, sizes))
         self.registry.counter("graftcheck",
                               f"recompiles.{self.name}").increment(
             self.compiles)
-        if self.compiles:
+        if self.compiles or self.attributions:
             # a cache miss inside an active trace span shows up INSIDE the
             # request/step that paid for it (late import: tracing is a
             # leaf module; this path only runs on the cold compile)
             from .tracing import TRACER
 
-            TRACER.instant("jit_recompile", {"guard": self.name,
-                                             "compiles": self.compiles})
+            if self.compiles:
+                TRACER.instant("jit_recompile", {"guard": self.name,
+                                                 "compiles": self.compiles})
+            for a in self.attributions:
+                TRACER.instant("jit_retrace_attrib",
+                               {"guard": self.name, "fn": a["fn"],
+                                "shapes": a["shapes"],
+                                "prev": a["prev"] or "",
+                                "shape_delta": a["delta"]})
         self.registry.set_gauge(f"{self.name}.jit_cache_entries",
                                 float(sum(sizes)))
         if exc_type is None and self.expect_stable and self.compiles:
+            attrib = "; ".join(
+                f"{a['fn']} {a['shapes']}"
+                + (" [shape delta]" if a["delta"] else "")
+                for a in self.attributions) \
+                or "no compile-log attribution captured"
             raise RuntimeError(
                 f"recompile_guard({self.name!r}): {self.compiles} jit cache "
                 f"miss(es) in a section expected steady — a G001-class "
-                f"hazard is retracing the step function")
+                f"hazard is retracing the step function ({attrib})")
 
 
 @contextlib.contextmanager

@@ -158,6 +158,17 @@ def _device_set(extra=None):
     return ds
 
 
+def _recompile_counters():
+    """Final ``graftcheck.recompiles.<guard>`` counter values, recorded
+    next to device_set in every BENCH JSON line: the artifact's own proof
+    the zero-recompile contract held (or exactly which guarded engine
+    retraced, and how often) — the dynamic end of the static G032-G036
+    traceflow rules."""
+    return {k.split("graftcheck.recompiles.", 1)[1]: v
+            for k, v in REGISTRY.snapshot().items()
+            if k.startswith("graftcheck.recompiles.")}
+
+
 def trace_report(trace_path):
     """Export the tracer ring to `trace_path` (Chrome/Perfetto JSON) and
     return the BENCH-JSON tracing block: per-stage time breakdown + the
@@ -413,6 +424,7 @@ def run_quantize_mode(args) -> int:
         "unit": "x",
         "methodology": meth,
         "device_set": _device_set(),
+        "recompiles": _recompile_counters(),
         "trials": int(args.quant_trials),
         "concurrency": int(args.concurrency),
         "requests_per_trial": len(pool),
@@ -633,6 +645,7 @@ def run_sharded_mode(args) -> int:
         "methodology": "interleaved_paired_trials_closed_loop_engine",
         "device_set": _device_set(
             {"mesh_shapes": [list(s) for s in mesh_shapes]}),
+        "recompiles": _recompile_counters(),
         "trials": int(args.quant_trials),
         "concurrency": int(args.concurrency),
         "requests_per_trial": len(pool),
@@ -822,6 +835,7 @@ def run_topk_mode(args) -> int:
         "unit": "queries/s",
         "methodology": "in_process_engine_interleaved_paired_trials",
         "device_set": _device_set(),
+        "recompiles": _recompile_counters(),
         "catalog_items": int(n_items),
         "k": int(k),
         "factor": int(args.mf_factor),
@@ -1289,6 +1303,7 @@ def _run_overload_mode(args) -> int:
         "unit": "x",
         "methodology": "http_open_loop_stepped_offered_load",
         "device_set": _device_set(),
+        "recompiles": _recompile_counters(),
         "calibration": {"burst_closed_loop_rps": round(burst_rps, 1),
                         "saturation_rps": round(rate_cap, 1),
                         "probes": probes,
@@ -1598,6 +1613,7 @@ def run_skew_mode(args) -> int:
         "unit": "x",
         "methodology": meth,
         "device_set": _device_set(),
+        "recompiles": _recompile_counters(),
         "trials": int(args.quant_trials),
         "requests_per_trial": int(args.requests),
         "rows_per_trial": int(rows_per_trial),
@@ -1885,6 +1901,7 @@ def run_http_mode(args, source, rows, tag) -> int:
         "unit": "req/s",
         "methodology": "http_post_predict_closed_loop",
         "device_set": _device_set(),
+        "recompiles": _recompile_counters(),
         "steady_state_recompiles": int(steady_recompiles),
         "warmup": {"compiles": warm_compiles,
                    "seconds": round(warm_s, 3)},
@@ -2298,6 +2315,7 @@ def main() -> int:
         "unit": "req/s",
         "methodology": "in_process_batcher_closed_loop",
         "device_set": _device_set(),
+        "recompiles": _recompile_counters(),
         "steady_state_recompiles": int(steady_recompiles),
         "warmup": {"compiles": int(warm_compiles),
                    "seconds": round(warm_s, 3),

@@ -131,6 +131,32 @@ if [[ $recovery_touched -eq 1 ]]; then
     fi
   done
 fi
+# the jit-hot surface is one compile-cache domain: an op/kernel signature
+# change retraces every serving dispatcher that jits over it, so edits to
+# the traced layers pull the serving dispatch modules into the scan — the
+# traceflow rules (G032-G036) prove cache-entry churn and retrace hazards
+# ACROSS that boundary, in callers that did not move
+jit_hot_touched=0
+for e in ${existing[@]+"${existing[@]}"}; do
+  case "$e" in
+    hivemall_tpu/ops/*|hivemall_tpu/kernels/*|\
+    hivemall_tpu/serving/engine.py|hivemall_tpu/serving/retrieval.py)
+      jit_hot_touched=1 ;;
+  esac
+done
+if [[ $jit_hot_touched -eq 1 ]]; then
+  echo "graftcheck: jit-hot surface changed — scanning the serving dispatch modules"
+  for f in hivemall_tpu/serving/engine.py hivemall_tpu/serving/retrieval.py \
+           hivemall_tpu/serving/sharded.py; do
+    present=0
+    for e in ${existing[@]+"${existing[@]}"}; do
+      [[ "$e" == "$f" ]] && present=1
+    done
+    if [[ $present -eq 0 && -f "$f" ]]; then
+      existing+=("$f")
+    fi
+  done
+fi
 if [[ ${#existing[@]} -eq 0 ]]; then
   echo "graftcheck: no changed python files under hivemall_tpu/"
   exit 0

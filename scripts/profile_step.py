@@ -12,9 +12,12 @@ benchmark loudly instead of publishing a compile-dominated number.
 `--trace-out PATH` additionally emits the same breakdown as a
 Chrome/Perfetto trace via runtime/tracing.py — one `profile.<kernel>` root
 per kernel with `compile` / `steady` child spans (the compile span carries
-the jit_recompile instant events recompile_guard fires), loadable in
-ui.perfetto.dev next to serving traces: training and serving share one
-trace format (docs/observability.md).
+the jit_recompile instant events recompile_guard fires, and one
+jit_retrace_attrib instant per compile naming the jitted function and its
+argument-shape delta — so a retrace inside the paying step span is
+attributed to a line, not just counted), loadable in ui.perfetto.dev next
+to serving traces: training and serving share one trace format
+(docs/observability.md).
 """
 import argparse
 import os
@@ -49,13 +52,16 @@ def timeit(name, fn, *args, n=20):
                 out = fn(*args)
             jax.block_until_ready(out)
             steady_ms = (time.perf_counter() - t0) / n * 1e3
-    return compile_ms, steady_ms, warm.compiles
+    return compile_ms, steady_ms, warm.compiles, warm.attributions
 
 
 def report(name, fn, *args, n=20):
-    compile_ms, steady_ms, misses = timeit(name, fn, *args, n=n)
+    compile_ms, steady_ms, misses, attribs = timeit(name, fn, *args, n=n)
     print(f"{name:<17}: {steady_ms:8.3f} ms/step steady | "
           f"first call {compile_ms:8.1f} ms ({misses} compile)")
+    for a in attribs:
+        delta = f" (was {a['prev']})" if a["delta"] else ""
+        print(f"{'':<17}   compiled {a['fn']} {a['shapes']}{delta}")
 
 
 def timeit_host(name, fn, *args, n=20):

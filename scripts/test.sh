@@ -35,6 +35,7 @@ import os
 from hivemall_tpu.analysis import analyze_paths
 from hivemall_tpu.analysis.baseline import load_baseline
 from hivemall_tpu.analysis.findings import parse_suppressions
+from hivemall_tpu.analysis.rules import RULE_DOCS
 from hivemall_tpu.analysis.sarif import render_sarif
 
 findings = analyze_paths(["hivemall_tpu"])
@@ -51,7 +52,9 @@ for root, _dirs, names in os.walk("hivemall_tpu"):
             supp.update(rules)
         supp.update(whole_file)
 print("graftcheck ledger (live findings / baselined / suppressions):")
-for rule in sorted(set(live) | set(based) | set(supp)):
+# every registered rule prints, zeros included — an all-zero row is the
+# ledger's proof the rule ran and the tree is clean, not that it was absent
+for rule in sorted(set(RULE_DOCS) | set(live) | set(based) | set(supp)):
     print("  %-5s %3d live  %3d baselined  %3d suppressed"
           % (rule, live[rule], based[rule], supp[rule]))
 with open("analysis.sarif", "w", encoding="utf-8") as fh:

@@ -1,0 +1,49 @@
+"""G033 negative fixture: shape-derived and static host decisions."""
+import jax
+import jax.numpy as jnp
+
+
+def _route(table, upd):
+    e, k = table.shape
+    if e * k < 1024:  # shape-derived: concrete at trace time
+        return table.reshape(-1), upd
+    return table, upd
+
+
+@jax.jit
+def scatter_step(table, upd):
+    flat, u = _route(table, upd)
+    return flat.sum() + u.sum()
+
+
+def _widen(v, width):
+    if width > 8:  # untraced host argument
+        return jnp.pad(v, (0, width - v.shape[0]))
+    return v
+
+
+@jax.jit
+def pad_step(v):
+    return _widen(v, 16)
+
+
+def _by_rank(v):
+    if v.ndim > 1:  # .ndim is static under trace
+        return v.reshape(-1)
+    return v
+
+
+@jax.jit
+def rank_step(v):
+    return _by_rank(v).sum()
+
+
+def _gate(v):
+    return jnp.ones(4) if v else jnp.zeros(4)
+
+
+score_static = jax.jit(_gate, static_argnums=(0,))
+
+
+def dispatch(flag):
+    return score_static(bool(flag))  # host scalar at the static position

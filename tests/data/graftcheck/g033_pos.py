@@ -1,0 +1,35 @@
+"""G033 positive fixture: host branches/conversions on traced values."""
+import jax
+import jax.numpy as jnp
+
+
+def _clip(delta, lo):
+    if delta < lo:  # EXPECT: G033
+        return lo
+    return delta
+
+
+@jax.jit
+def update(w, delta):
+    return w + _clip(delta, 0.0)
+
+
+def _log_norm(v):
+    return float(jnp.sum(v))  # EXPECT: G033
+
+
+@jax.jit
+def norm_step(w):
+    return w * _log_norm(w)
+
+
+def _gate(v):
+    return jnp.ones(4) if v else jnp.zeros(4)
+
+
+score_static = jax.jit(_gate, static_argnums=(0,))
+
+
+def dispatch(xs):
+    dev = jnp.asarray(xs)
+    return score_static(dev)  # EXPECT: G033

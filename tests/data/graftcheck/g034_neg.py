@@ -1,0 +1,31 @@
+"""G034 negative fixture: bucket-routed or static shapes at jit call sites."""
+# graftcheck: jit-hot-module
+import jax
+import jax.numpy as jnp
+
+from hivemall_tpu.core.batch import bucket_rows
+
+
+def _score(v):
+    return jnp.sum(v * 2.0, axis=-1)
+
+
+scorer = jax.jit(_score)
+
+
+def predict(batch, n):
+    live = bucket_rows(batch[:n])
+    return scorer(live)[:n]
+
+
+def predict_inline(batch, n):
+    return scorer(bucket_rows(batch[:n]))[:n]
+
+
+def predict_fixed(batch):
+    head = batch[:128]
+    return scorer(head)
+
+
+def predict_whole(batch):
+    return scorer(batch)

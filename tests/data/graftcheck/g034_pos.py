@@ -1,0 +1,20 @@
+"""G034 positive fixture: unbucketed dynamic shapes reaching jitted callees."""
+# graftcheck: jit-hot-module
+import jax
+import jax.numpy as jnp
+
+
+def _score(v):
+    return jnp.sum(v * 2.0, axis=-1)
+
+
+scorer = jax.jit(_score)
+
+
+def predict(batch, n):
+    live = batch[:n]
+    return scorer(live)  # EXPECT: G034
+
+
+def predict_inline(batch, n):
+    return scorer(batch[:n])  # EXPECT: G034

@@ -1,0 +1,29 @@
+"""G035 negative fixture: donated buffers rebound before reuse."""
+import jax
+import jax.numpy as jnp
+
+
+def _accum(best, cand):
+    return jnp.maximum(best, cand)
+
+
+merge = jax.jit(_accum, donate_argnums=(0,))
+
+
+def run(blocks, best):
+    for cand in blocks:
+        best = merge(best, cand)  # the carry rebinds the donated buffer
+    return best
+
+
+def _build_merge():
+    return jax.jit(_accum, donate_argnums=(0,))
+
+
+class Reducer:
+    def __init__(self):
+        self._merge = _build_merge()
+
+    def reduce(self, best, cand):
+        best = self._merge(best, cand)
+        return best

@@ -1,0 +1,30 @@
+"""G035 positive fixture: donated buffers reused across calls."""
+import jax
+import jax.numpy as jnp
+
+
+def _accum(best, cand):
+    return jnp.maximum(best, cand)
+
+
+merge = jax.jit(_accum, donate_argnums=(0,))
+
+
+def run(blocks, best):
+    out = None
+    for cand in blocks:
+        out = merge(best, cand)  # EXPECT: G035
+    return out
+
+
+def _build_merge():
+    return jax.jit(_accum, donate_argnums=(0,))
+
+
+class Reducer:
+    def __init__(self):
+        self._merge = _build_merge()
+
+    def reduce(self, best, cand):
+        best2 = self._merge(best, cand)
+        return best + best2  # EXPECT: G035

@@ -73,7 +73,8 @@ RULES = ["g001", "g002", "g003", "g004", "g005", "g006",
          "g012", "g013", "g014", "g015", "g016",
          "g017", "g018", "g019", "g020", "g021",
          "g022", "g023", "g024", "g025", "g026",
-         "g027", "g028", "g029", "g030", "g031"]
+         "g027", "g028", "g029", "g030", "g031",
+         "g032", "g033", "g034", "g035", "g036"]
 
 # the four hot-path modules the acceptance criteria pin at zero G001/G002
 HOT_MODULES = [
@@ -784,7 +785,7 @@ def test_fixer_round_trip_g030_wrap_finally(tmp_path):
 
 
 def test_failure_path_sarif_fingerprints_stable():
-    """G027-G031 ship in the SARIF rules array under tool version 6.0 and
+    """G027-G036 ship in the SARIF rules array under tool version 7.0 and
     their results carry partialFingerprints that are byte-stable across
     runs (the CI dedup key)."""
     fixtures = [os.path.join(DATA, "g027_pos.py"),
@@ -793,9 +794,10 @@ def test_failure_path_sarif_fingerprints_stable():
     assert proc.returncode == 1, proc.stdout + proc.stderr
     payload = json.loads(proc.stdout)
     driver = payload["runs"][0]["tool"]["driver"]
-    assert driver["version"] == "6.0"
+    assert driver["version"] == "7.0"
     rule_ids = [r["id"] for r in driver["rules"]]
-    assert {"G027", "G028", "G029", "G030", "G031"} <= set(rule_ids)
+    assert {"G027", "G028", "G029", "G030", "G031",
+            "G032", "G033", "G034", "G035", "G036"} <= set(rule_ids)
     results = payload["runs"][0]["results"]
     assert {r["ruleId"] for r in results} == {"G027", "G030"}
     for r in results:
@@ -872,7 +874,175 @@ def test_jobs_parallel_findings_match_serial():
     included — must be identical to the serial run so baselines and
     SARIF fingerprints stay stable."""
     paths = [os.path.join(DATA, n) for n in
-             ("g001_pos.py", "g012_pos.py", "g027_pos.py", "g031_pos.py")]
+             ("g001_pos.py", "g012_pos.py", "g027_pos.py", "g031_pos.py",
+              "g032_pos.py", "g034_pos.py")]
     serial = [f.format() for f in analyze_paths(paths, jobs=1)]
     threaded = [f.format() for f in analyze_paths(paths, jobs=4)]
     assert serial and threaded == serial
+    # and the SARIF rendering of the two runs is byte-identical
+    from hivemall_tpu.analysis.sarif import render_sarif
+    assert json.dumps(render_sarif(analyze_paths(paths, jobs=4)),
+                      sort_keys=True) \
+        == json.dumps(render_sarif(analyze_paths(paths, jobs=1)),
+                      sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# v7: traceflow (G032-G036) — jit-cache churn & retrace hazards
+# ---------------------------------------------------------------------------
+
+def test_jit_hot_modules_are_traceflow_clean():
+    """Acceptance (v7): the jit-hot surface — serving dispatch plus the
+    traced op/kernel layers — carries ZERO non-baselined G032-G036
+    findings, and none of that debt hides in the baseline either: the
+    zero-recompile contract is statically proven, not deferred."""
+    tf_rules = ("G032", "G033", "G034", "G035", "G036")
+    paths = [os.path.join(PKG, "serving", "engine.py"),
+             os.path.join(PKG, "serving", "retrieval.py"),
+             os.path.join(PKG, "serving", "sharded.py"),
+             os.path.join(PKG, "ops"),
+             os.path.join(PKG, "kernels")]
+    hits = [f for f in analyze_paths(paths) if f.rule in tf_rules]
+    assert hits == [], "\n".join(f.format() for f in hits)
+    baselined = [b for b in load_baseline() if b.rule in tf_rules]
+    assert baselined == [], \
+        "traceflow debt must be fixed or suppressed with rationale, " \
+        "not baselined"
+
+
+def test_fixer_round_trip_g032_eta(tmp_path):
+    """--fix rewrites the eta-expanded lambda to the named function; the
+    closure/partial/loop findings carry no fix and survive, and a second
+    run plans nothing."""
+    import shutil
+
+    target = tmp_path / "g032_case.py"
+    shutil.copy(os.path.join(DATA, "g032_pos.py"), target)
+    proc = _cli(str(target), "--fix", "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = target.read_text()
+    assert "jax.jit(lambda v: _score(v))" not in fixed
+    assert "scorer = jax.jit(_score)" in fixed
+    remaining = [f for f in analyze_paths([str(target)])
+                 if f.rule == "G032"]
+    assert len(remaining) == 3, "closure, partial and loop findings stay"
+    assert all(f.fix is None for f in remaining)
+    proc2 = _cli(str(target), "--fix", "--no-baseline")
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "no applicable fixes" in proc2.stdout
+
+
+def test_fixer_round_trip_g034_bucket_route(tmp_path):
+    """--fix routes the bare-name dynamic-slice argument through
+    bucket_rows (adding the import) and slices the result back; the
+    inline-slice finding keeps no fix; --fix-check then agrees (rc 0)."""
+    import shutil
+
+    target = tmp_path / "g034_case.py"
+    shutil.copy(os.path.join(DATA, "g034_pos.py"), target)
+    proc = _cli(str(target), "--fix", "--no-baseline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    fixed = target.read_text()
+    assert "from hivemall_tpu.core.batch import bucket_rows" in fixed
+    assert "scorer(bucket_rows(live))[:live.shape[0]]" in fixed
+    remaining = [f for f in analyze_paths([str(target)])
+                 if f.rule == "G034"]
+    assert len(remaining) == 1, "only the inline slice may remain"
+    assert remaining[0].fix is None
+    check = _cli(str(target), "--fix-check", "--no-baseline")
+    assert check.returncode == 0, check.stdout + check.stderr
+
+
+_PLANTED_CHURN = '''\
+import jax
+import jax.numpy as jnp
+
+
+def fresh_scorer():
+    def churn_score(x):
+        return jnp.sum(x * 2.0)
+    return jax.jit(churn_score)
+
+
+def drive(blocks):
+    out = []
+    for b in blocks:
+        out.append(fresh_scorer()(b))
+    return out
+'''
+
+
+def test_planted_retrace_caught_statically_and_dynamically():
+    """Acceptance (v7): ONE planted retrace hazard is caught by BOTH ends
+    of the loop. Statically, G032 flags the nested-def jit site and the
+    loop-driven constructor call. Dynamically, executing the same source
+    recompiles once per iteration while a named probe's cache-size counter
+    stays flat (the blind spot) — and the guard's compile-log attribution
+    names exactly the function the static finding points at."""
+    hits = [f for f in analyze_source(_PLANTED_CHURN, "planted.py")
+            if f.rule == "G032"]
+    assert len(hits) == 2, "\n".join(f.format() for f in hits)
+    site = [f for f in hits if "churn_score" in f.snippet]
+    assert site, "the jit site finding must name the churned function"
+
+    import jax
+    import jax.numpy as jnp
+
+    from hivemall_tpu.runtime.metrics import recompile_guard
+
+    ns = {}
+    exec(compile(_PLANTED_CHURN, "planted.py", "exec"), ns)
+    probe = jax.jit(lambda v: v + 0.0)
+    blocks = [jnp.ones((4,), jnp.float32)] * 3
+    probe(blocks[0])  # warm the named probe outside the guard
+    with recompile_guard("planted_churn", probe) as g:
+        ns["drive"](blocks)
+    assert g.compiles == 0, "the named probe must be blind to the churn"
+    churned = [a["fn"] for a in g.attributions]
+    assert churned.count("churn_score") >= 3, g.attributions
+    assert all(not a["delta"] for a in g.attributions
+               if a["fn"] == "churn_score" and a["prev"] is None)
+
+
+def test_retrace_attribution_labels_shape_delta():
+    """A recompile at a NEW argument shape is attributed with the previous
+    shape signature and delta=True — the shape-churn half of the
+    attribution story (vs identity churn, delta=False)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hivemall_tpu.runtime.metrics import recompile_guard
+
+    def delta_probe_fn(x):
+        return jnp.sum(x) * 3.0
+
+    wrapped = jax.jit(delta_probe_fn)
+    with recompile_guard("delta_probe_a", wrapped) as ga:
+        wrapped(jnp.ones((4,), jnp.float32))
+    first = [a for a in ga.attributions if a["fn"] == "delta_probe_fn"]
+    assert first and first[0]["prev"] is None and not first[0]["delta"]
+    with recompile_guard("delta_probe_b", wrapped) as gb:
+        wrapped(jnp.ones((8,), jnp.float32))
+    second = [a for a in gb.attributions if a["fn"] == "delta_probe_fn"]
+    assert second, gb.attributions
+    assert second[0]["delta"] is True
+    assert "float32[4]" in second[0]["prev"]
+    assert "float32[8]" in second[0]["shapes"]
+
+
+def test_expect_stable_raise_carries_attribution():
+    """The expect_stable failure message names the retracing function and
+    its shapes — the static finding and the runtime raise point at the
+    same line."""
+    import jax
+    import jax.numpy as jnp
+
+    from hivemall_tpu.runtime.metrics import recompile_guard
+
+    def cold_step_fn(x):
+        return x * 5.0
+
+    wrapped = jax.jit(cold_step_fn)
+    with pytest.raises(RuntimeError, match="cold_step_fn"):
+        with recompile_guard("cold_step", wrapped, expect_stable=True):
+            wrapped(jnp.ones((3,), jnp.float32))
