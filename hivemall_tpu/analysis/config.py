@@ -100,9 +100,16 @@ MUTATOR_METHODS = ("append", "appendleft", "extend", "insert", "add",
 # pipeline is in scope by prefix: its worker thread shares the registry with
 # request handlers, so a freeze/gate/deploy under its lock would stall
 # every concurrent status()/lineage read exactly when a swap is in flight.
+# The observability stack (metrics registry + endpoint, time-series
+# sampler, SLO engine) is hot the same way: the sampler thread, ring
+# listeners and HTTP scrape handlers all take its locks concurrently with
+# request handlers, so a registry snapshot or listener callback under a
+# ring/engine lock stalls both the sampler AND every /metrics scrape.
 CONCURRENCY_HOT_PREFIXES = ("hivemall_tpu/serving/",
                             "hivemall_tpu/pipeline/",
-                            "hivemall_tpu/runtime/metrics")
+                            "hivemall_tpu/runtime/metrics",
+                            "hivemall_tpu/runtime/timeseries",
+                            "hivemall_tpu/runtime/slo")
 CONCURRENCY_MARKER = "# graftcheck: serving-module"
 
 # Blocking-call classification for G013 (tails of dotted callees).

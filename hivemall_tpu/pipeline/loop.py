@@ -307,6 +307,23 @@ class ContinuousPipeline:
                         TRACER.instant("pipeline.restart",
                                        args={"cause": type(e).__name__})
                         if restarts > self.cfg.max_restarts:
+                            # supervisor give-up: leave the postmortem
+                            # artifact (flight recorder) next to the
+                            # versioned artifacts BEFORE re-raising —
+                            # write_crash_bundle never raises, so the
+                            # original exception stays the signal
+                            from ..runtime.debug_bundle import \
+                                write_crash_bundle
+
+                            write_crash_bundle(
+                                os.path.join(
+                                    self.cfg.artifact_root,
+                                    f"{self.cfg.name}_crash_bundle.json"),
+                                reason=(f"pipeline {self.cfg.name!r} gave "
+                                        f"up after {restarts} restarts "
+                                        f"(last cause: "
+                                        f"{type(e).__name__}: {e})"),
+                                registry=self.registry)
                             raise
                         time.sleep(min(
                             self.cfg.restart_backoff_s * restarts, 1.0))

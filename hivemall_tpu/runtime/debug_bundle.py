@@ -1,0 +1,187 @@
+"""Flight recorder: one self-contained JSON snapshot of the whole process.
+
+A postmortem today starts with "what did /metrics say before it died" —
+answered, if at all, by whatever a human happened to scrape. The bundle
+answers it by construction: everything the process knows about itself, in
+one strictly-JSON document —
+
+- ``versions`` + ``device_set``: what code ran on what hardware;
+- ``models``: the serving registry's full ``describe()`` per model —
+  placement, admission/controller state, cache, lineage, retrieval;
+- ``metrics``: the registry's typed snapshot (exemplars included — in a
+  postmortem the trace links ARE the payload);
+- ``timeseries``: the recent history ring (runtime/timeseries.py), so
+  trends up to the incident survive it;
+- ``slo``: every objective's burn rates, state and transition history;
+- ``traces``: the last-N committed traces INCLUDING the slow reserve,
+  the top-5 slowest, and the per-stage breakdown (runtime/tracing.py);
+- ``recompiles``: the per-guard counters plus the process-wide
+  last-compiled-shapes table — retrace attribution at the crash site.
+
+Two consumers: ``GET /debug/bundle`` (runtime/metrics_http.py — one curl
+mid-incident) and ``write_crash_bundle`` at the supervisor give-up points
+(pipeline/loop.py, runtime/recovery.py — every crash leaves this artifact
+next to its checkpoints). The crash writer NEVER raises: masking the
+original exception with a telemetry error would be strictly worse than
+losing the bundle.
+
+Strict JSON: ``float('inf')`` histogram bounds and NaN gauges are
+sanitized to strings/None (``json.dumps`` would happily emit
+``Infinity``, which ``JSON.parse`` and strict decoders reject — the
+Histogram.quantile docstring's warning, applied at the boundary).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+from typing import Optional
+
+from .metrics import _LAST_COMPILED_SHAPES, REGISTRY
+from .tracing import TRACER
+
+BUNDLE_VERSION = 1
+
+# every top-level section a complete bundle carries (tests and the --slo
+# bench gate check the document against this list)
+SECTIONS = ("bundle_version", "generated_unix", "reason", "versions",
+            "device_set", "models", "health", "metrics", "timeseries",
+            "slo", "traces", "recompiles")
+
+
+def _sanitize(obj):
+    """Strict-JSON walker: inf/-inf/NaN floats become "+Inf"/"-Inf"/None,
+    tuples become lists, dict keys become strings (histogram bucket maps
+    key on float bounds), unknown objects fall back to repr."""
+    if isinstance(obj, float):
+        if math.isinf(obj):
+            return "+Inf" if obj > 0 else "-Inf"
+        if math.isnan(obj):
+            return None
+        return obj
+    if isinstance(obj, (str, int, bool)) or obj is None:
+        return obj
+    if isinstance(obj, dict):
+        return {_key(k): _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [_sanitize(v) for v in obj]
+    item = getattr(obj, "item", None)
+    if callable(item):  # numpy scalars without importing numpy here
+        try:
+            return _sanitize(item())
+        except Exception:  # graftcheck: disable=G029 (best-effort serialization: repr below is the documented degrade)
+            pass
+    return repr(obj)
+
+
+def _key(k) -> str:
+    if isinstance(k, str):
+        return k
+    if isinstance(k, float) and math.isinf(k):
+        return "+Inf" if k > 0 else "-Inf"
+    return str(k)
+
+
+def _versions() -> dict:
+    from .. import VERSION
+
+    out = {"hivemall_tpu": VERSION,
+           "python": sys.version.split()[0]}
+    for mod in ("jax", "numpy"):
+        try:
+            out[mod] = __import__(mod).__version__
+        except Exception:  # graftcheck: disable=G028,G029 (version probe: an absent dep is recorded as absent, not an error)
+            out[mod] = None
+    return out
+
+
+def _device_set() -> dict:
+    try:
+        import jax
+
+        return {"platform": jax.default_backend(),
+                "device_count": jax.device_count(),
+                "local_device_count": jax.local_device_count(),
+                "process_count": jax.process_count(),
+                "process_index": jax.process_index(),
+                "device_kinds": sorted({d.device_kind
+                                        for d in jax.devices()})}
+    except Exception:  # graftcheck: disable=G028,G029 (probe: a bundle written before/without jax init records the absence instead of failing the crash path)
+        return {"platform": None}
+
+
+def build_bundle(registry=None, reason: str = "on-demand",
+                 n_traces: int = 50,
+                 history_s: Optional[float] = None,
+                 max_history_samples: int = 240) -> dict:
+    """The bundle as a strictly-JSON-safe dict. ``registry`` is a serving
+    ``ModelRegistry`` when one exists (the /debug/bundle handler passes
+    the server's); None leaves ``models``/``health`` empty — the crash
+    writers in training-only processes have no registry to describe."""
+    from . import timeseries
+    from .slo import ENGINE
+
+    models, health = [], None
+    if registry is not None:
+        try:
+            models = registry.list_models()
+            health = registry.health()
+        except Exception as e:  # graftcheck: disable=G029 (a mid-shutdown registry must not fail the bundle; the error string IS the section's content)
+            health = {"error": repr(e)}
+    bundle = {
+        "bundle_version": BUNDLE_VERSION,
+        "generated_unix": time.time(),
+        "reason": reason,
+        "versions": _versions(),
+        "device_set": _device_set(),
+        "models": models,
+        "health": health,
+        "metrics": REGISTRY.typed_snapshot(),
+        "timeseries": timeseries.RING.history(
+            seconds=history_s, max_samples=max_history_samples),
+        "slo": ENGINE.status(),
+        "traces": {
+            "last": TRACER.traces(n_traces),
+            "slowest": TRACER.slowest(5),
+            "stage_breakdown_ms": TRACER.stage_breakdown(),
+            "dropped": TRACER.dropped,
+        },
+        "recompiles": {
+            "counters": {k.split("graftcheck.recompiles.", 1)[1]: v
+                         for k, v in REGISTRY.snapshot().items()
+                         if k.startswith("graftcheck.recompiles.")},
+            "last_compiled_shapes": dict(_LAST_COMPILED_SHAPES),
+        },
+    }
+    return _sanitize(bundle)
+
+
+def write_bundle(path: str, registry=None, reason: str = "on-demand",
+                 **kwargs) -> str:
+    """Build and write a bundle to ``path`` atomically (tmp + replace —
+    a crash mid-write leaves no half-bundle). Raises on IO errors; the
+    crash path wants ``write_crash_bundle`` instead."""
+    doc = build_bundle(registry=registry, reason=reason, **kwargs)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return path
+
+
+def write_crash_bundle(path: str, reason: str,
+                       registry=None) -> Optional[str]:
+    """``write_bundle`` that NEVER raises — the supervisor give-up paths
+    (pipeline/loop.py, runtime/recovery.py) call this immediately before
+    re-raising the fatal exception, and a telemetry failure must not mask
+    it. Returns the path, or None when the write failed (the caller's
+    exception is already the loud signal)."""
+    try:
+        return write_bundle(path, registry=registry, reason=reason)
+    except Exception:  # graftcheck: disable=G028,G029 (crash path: the original exception re-raised by the caller is the signal; a bundle-write error must not replace it)
+        return None

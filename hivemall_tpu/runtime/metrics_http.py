@@ -13,7 +13,15 @@ as an HTTP scrape endpoint instead:
 - `GET /healthz`  — liveness (200 + json with process/device info);
 - `GET /trace?n=` — the last n committed traces from the process tracer
   (runtime/tracing.py) as Chrome trace_event JSON: save the body to a
-  file and load it in ui.perfetto.dev (docs/observability.md).
+  file and load it in ui.perfetto.dev (docs/observability.md);
+- `GET /slo`      — every registered objective's multi-window burn rates,
+  ok/warn/page state and recent transitions (runtime/slo.py);
+- `GET /debug/bundle?n=` — the flight-recorder snapshot: versions,
+  device set, deployed models, metrics + time-series history, SLO state,
+  last-n traces (slow reserve included) and recompile attributions in one
+  strictly-JSON document (runtime/debug_bundle.py). When the server
+  carries a serving registry (serving/server.py rides this handler), the
+  bundle includes every model's describe().
 
 `serve_metrics(port)` starts a daemon thread (stdlib only); every worker
 started by bin/hivemall_tpu_daemon.sh can enable it with
@@ -119,6 +127,30 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 n = 20
             body = json.dumps(TRACER.chrome_trace(n=n)).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path.split("?")[0] == "/slo":
+            # late import: slo pulls timeseries; scrape-only processes
+            # that never registered an objective still stay light
+            from .slo import ENGINE
+
+            body = json.dumps(ENGINE.status()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path.split("?")[0] == "/debug/bundle":
+            from .debug_bundle import build_bundle
+
+            qs = parse_qs(urlparse(self.path).query)
+            try:
+                n = int(qs.get("n", ["50"])[0])
+            except ValueError:
+                n = 50
+            # serving servers carry a registry attribute (serve() in
+            # serving/server.py); the bare metrics endpoint does not —
+            # the bundle simply omits the models section there
+            body = json.dumps(build_bundle(
+                registry=getattr(self.server, "registry", None),
+                n_traces=n)).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif self.path.split("?")[0] == "/healthz":

@@ -514,6 +514,19 @@ def _run_elastic_loop(make_trainer, data_fn, n_steps, path, checkpoint_every,
                     {"type": type(e).__name__, "step": completed,
                      "devices": len(devices)})
                 if report["restarts"] > max_restarts:
+                    # supervisor give-up: drop the flight-recorder bundle
+                    # next to the checkpoint before re-raising (the crash
+                    # postmortem artifact; write_crash_bundle never
+                    # raises, so the fatal exception stays the signal)
+                    from .debug_bundle import write_crash_bundle
+
+                    write_crash_bundle(
+                        path + ".crash_bundle.json",
+                        reason=(f"run_elastic gave up after "
+                                f"{report['restarts']} restarts (last "
+                                f"cause: {type(e).__name__}: {e}; "
+                                f"devices={len(devices)}, "
+                                f"step={completed})"))
                     raise
                 time.sleep(min(RESTART_BACKOFF_S * report["restarts"], 1.0))
                 if isinstance(e, faults.WorkerLost):

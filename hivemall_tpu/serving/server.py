@@ -32,7 +32,14 @@ HTTP surface (layered on runtime/metrics_http.py — same process, one port):
   and placement state, counters);
 - ``GET /healthz``   overload-aware: reports ``degraded`` (still 200 —
   alive, shedding predictably) when any model's queue passes the depth
-  threshold, BEFORE the process ever looks dead;
+  threshold OR any registered SLO is paging on its burn rate
+  (runtime/slo.py — the ``slo`` block carries the detail), BEFORE the
+  process ever looks dead;
+- ``GET /slo`` / ``GET /debug/bundle`` — inherited from metrics_http:
+  per-objective multi-window burn rates + alert states, and the
+  flight-recorder snapshot (models, metrics + time-series history,
+  traces, recompile attributions) in one JSON document
+  (docs/observability.md "SLOs & burn rates", "Flight recorder");
 - ``GET /metrics`` / ``GET /trace?n=`` — inherited from metrics_http:
   the serving latency/occupancy/queue histograms, per-priority
   shed/expiry/quota counters and live controller state (with trace
@@ -54,7 +61,8 @@ import numpy as np
 from ..runtime import metrics_http
 from ..runtime.metrics import REGISTRY
 from ..runtime.tracing import TRACER
-from .admission import DeadlineExpired, priority_class, priority_name
+from .admission import (PRIORITY_NAMES, DeadlineExpired, priority_class,
+                        priority_name)
 from .batcher import BatcherClosed, DynamicBatcher, QueueFull
 from .engine import ServingEngine
 
@@ -468,8 +476,21 @@ class _ServingHandler(metrics_http._Handler):
             return
         if path == "/healthz":
             # overload-aware liveness: "degraded" reports a server that is
-            # alive and shedding predictably BEFORE it ever looks dead
-            self._send_json(200, self.server.registry.health())
+            # alive and shedding predictably BEFORE it ever looks dead.
+            # Queue depth is the instantaneous signal; the SLO engine's
+            # burn state (runtime/slo.py) is the over-time one — a paging
+            # objective degrades health even while the queue happens to
+            # look shallow, so a front door routing on /healthz sees
+            # both (ROADMAP fleet-serving: per-replica health a router
+            # can trust)
+            from ..runtime.slo import ENGINE
+
+            info = self.server.registry.health()
+            slo_block = ENGINE.health_block()
+            info["slo"] = slo_block
+            if slo_block["paging"]:
+                info["status"] = "degraded"
+            self._send_json(200, info)
             return
         super().do_GET()
 
@@ -615,9 +636,15 @@ class _ServingHandler(metrics_http._Handler):
                                 extra_headers=tp_hdr)
                 root.set(status=500)
                 return
+            dt = time.perf_counter() - t0
             self.server.latency.observe(
-                time.perf_counter() - t0,
-                trace_id=TRACER.exemplar_id(root))
+                dt, trace_id=TRACER.exemplar_id(root))
+            # per-priority-class twin of the aggregate histogram: the
+            # class rides the metric name (serving.http.latency_seconds.
+            # high/normal/low — the counter convention), so /metrics can
+            # answer "is the high class actually protected" and the SLO
+            # engine can target one class (docs/serving.md)
+            self.server.latency_by_class[cls].observe(dt)
             root.set(status=200, version=entry.version)
             self._send_json(200, {
                 "model": entry.name,
@@ -722,9 +749,10 @@ class _ServingHandler(metrics_http._Handler):
                                 extra_headers=tp_hdr)
                 root.set(status=500)
                 return
+            dt = time.perf_counter() - t0
             self.server.latency.observe(
-                time.perf_counter() - t0,
-                trace_id=TRACER.exemplar_id(root))
+                dt, trace_id=TRACER.exemplar_id(root))
+            self.server.latency_by_class[cls].observe(dt)
             root.set(status=200, version=entry.version)
             self._send_json(200, {
                 "model": entry.name,
@@ -759,6 +787,12 @@ def serve(registry: ModelRegistry, port: int = 0, host: str = "127.0.0.1",
     server = ThreadingHTTPServer((host, port), _ServingHandler)
     server.registry = registry
     server.latency = REGISTRY.histogram("serving.http.latency_seconds")
+    # the per-priority-class split of the same histogram (indexed by the
+    # admission class int): multi-tenancy per-tenant counters will ride
+    # this shape
+    server.latency_by_class = tuple(
+        REGISTRY.histogram(f"serving.http.latency_seconds.{p}")
+        for p in PRIORITY_NAMES)
     if max_concurrent_requests is None:
         server.inflight = server.inflight_reserve = None
     else:

@@ -106,6 +106,20 @@ additionally gate high-priority p99 at 2x overload <= 2x its light-load
 p99 — the priority classes must actually protect the high class.
 ``--smoke`` is tier-1 gate 7 in scripts/test.sh.
 
+`--slo` reuses the overload ladder as an end-to-end alerting gate
+(docs/observability.md "SLOs & burn rates"): the time-series sampler
+(runtime/timeseries.py) and SLO engine (runtime/slo.py) run live on the
+process singletons while light -> 2x-saturation -> recovery phases drive
+POST /predict, so ``GET /slo``, the SLO-aware ``/healthz`` and
+``GET /debug/bundle`` are exercised mid-incident over real sockets. Hard
+gates: the latency burn-rate alert must FIRE (reach ``page``) during the
+2x step and CLEAR after recovery, must NOT fire at light load, the
+sampler must cost < 5% of wall time, the mid-overload flight-recorder
+bundle must carry every section (models, metrics, time series, SLO
+state, traces, recompile attributions), and the ladder must run with
+zero steady-state recompiles. ``--smoke`` is tier-1 gate 13 in
+scripts/test.sh.
+
 Every mode records the ``device_set`` it actually measured on (platform,
 device count, device kinds, process count — plus the mesh shapes a
 sharded run used), the bench.py discipline since PR 6: a round that fell
@@ -1366,6 +1380,332 @@ def _run_overload_mode(args) -> int:
     return rc
 
 
+# -- slo mode: burn-rate alerting over the overload ladder -------------------
+
+def run_slo_mode(args) -> int:
+    """SLO burn-rate alert gate: drive the overload ladder (light -> 2x
+    saturation -> recovery) with the time-series sampler + SLO engine
+    live, and pin that the latency burn alert FIRES during the induced
+    overload, CLEARS after recovery, the sampler stays under 5% overhead,
+    and the mid-overload /debug/bundle is complete.
+    """
+    # same GIL posture as the overload sweep: dozens of runnable threads
+    # convoy at the default 5 ms switch interval, straight into the p99
+    prev_switch = sys.getswitchinterval()
+    sys.setswitchinterval(0.001)
+    try:
+        return _run_slo_mode(args)
+    finally:
+        sys.setswitchinterval(prev_switch)
+
+
+def _run_slo_mode(args) -> int:
+    from hivemall_tpu.runtime import timeseries
+    from hivemall_tpu.runtime.slo import ENGINE, SLO
+    from hivemall_tpu.serving import ModelRegistry
+    from hivemall_tpu.serving.admission import PRIORITY_NAMES
+    from hivemall_tpu.serving.server import serve
+
+    model, rows = _train_default(args.dims, args.train_rows)
+    registry = ModelRegistry(
+        max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+        engine_kwargs={"max_batch": args.max_batch,
+                       "max_width": args.max_width})
+    registry.deploy("bench", model, version="1")
+    server = serve(registry)
+    port = server.server_address[1]
+
+    # calibration: the overload mode's closed-loop burst over the same
+    # persistent-connection driver, doubling as HTTP-path warmup
+    calib_pool = _request_pool(rows, args.calib_requests,
+                               args.instances_per_request)
+    calib_bodies = [json.dumps({"model": "bench", "instances": req}).encode()
+                    for req in calib_pool]
+    nodeadline = (1e4, 1e4, 1e4)
+    recs, wall = _overload_step(port, calib_bodies,
+                                np.ones(len(calib_bodies), dtype=int),
+                                rate=1e6, deadlines_ms=nodeadline,
+                                workers=args.concurrency, timeout=60.0)
+    if not any(r[1] == 200 for r in recs):
+        print(f"SLO FAIL: calibration served nothing ({recs[:3]})",
+              file=sys.stderr)
+        return 1
+    burst_rps = len(recs) / wall
+    mean_rows = sum(len(r) for r in calib_pool) / len(calib_pool)
+
+    # saturation search: the fixed-worker closed loop can understate the
+    # OPEN-LOOP capacity (the sweep scales its worker pool with the
+    # offered rate) as badly as it overstates the sustainable rate on a
+    # loaded host — and an "overload" phase anchored under capacity never
+    # queues, so the alert it is supposed to trip never has cause. Find
+    # the knee the way the overload sweep does: climb offered rates with
+    # the sweep's own driver until goodput falls under 90% of offered;
+    # the last rate that held is the saturation anchor.
+    probe_s = min(2.0, args.step_seconds / 2)
+    sat = burst_rps * 0.25
+    probe = sat
+    while probe <= burst_rps * 8.0:
+        n = max(16, int(probe * probe_s))
+        recs, wall = _overload_step(
+            port, [calib_bodies[i % len(calib_bodies)] for i in range(n)],
+            np.ones(n, dtype=int), rate=probe, deadlines_ms=nodeadline,
+            workers=int(min(args.max_workers, max(8, probe * 0.25))),
+            timeout=60.0)
+        good = sum(1 for r in recs if r[1] == 200) / wall
+        if good < 0.9 * probe:
+            break
+        sat = probe
+        probe *= 1.6
+    # the 2x step must be transportable by the joint client+server system
+    # RIGHT NOW, or the "overload" melts into client slip instead of the
+    # server-side queueing the burn alert watches: validate once and
+    # re-anchor down if the schedule slips
+    top = sat * 2.0
+    n = max(24, int(top * probe_s))
+    recs, wall = _overload_step(
+        port, [calib_bodies[i % len(calib_bodies)] for i in range(n)],
+        np.ones(n, dtype=int), rate=top, deadlines_ms=nodeadline,
+        workers=int(min(args.max_workers, max(8, top * 0.25))), timeout=60.0)
+    achieved_top = len(recs) / wall
+    if achieved_top < 0.8 * top:
+        sat = achieved_top / 2.0
+
+    # admission posture sized from measured capacity (the PR 10 ladder
+    # deploy: bounded queue-seconds of backlog, quota fracs, door limit)
+    max_queue_rows = max(4 * args.max_batch,
+                         int(sat * mean_rows * args.queue_seconds))
+    inflight_limit = max(12, int(max_queue_rows / max(1.0, mean_rows)) + 4)
+    server.inflight = threading.BoundedSemaphore(inflight_limit)
+    server.inflight_reserve = threading.BoundedSemaphore(
+        max(2, inflight_limit // 4))
+    registry.deploy(
+        "bench", model, version="2",
+        batcher_overrides=dict(max_queue_rows=max_queue_rows,
+                               max_delay_ms_cap=args.max_delay_ms_cap,
+                               max_batch_cap=args.max_batch,
+                               priority_quota_fracs=(1.0, 0.85, 0.6)))
+    n_warm = 4 * inflight_limit
+    _overload_step(port, [calib_bodies[i % len(calib_bodies)]
+                          for i in range(n_warm)],
+                   np.ones(n_warm, dtype=int), rate=1e6,
+                   deadlines_ms=nodeadline,
+                   workers=args.concurrency, timeout=60.0)
+
+    # the sampler + SLO engine, on the PROCESS singletons — GET /slo,
+    # /healthz and /debug/bundle read those, and this gate checks the
+    # HTTP surface mid-overload, not private objects. Windows scale with
+    # the step so the full-size run exercises the same mechanics.
+    step_s = args.step_seconds
+    interval = max(0.05, step_s / 16.0)
+    fast_w = max(3 * interval, step_s / 5.0)
+    slow_w = max(2 * fast_w, step_s * 0.8)
+    ring = timeseries.RING
+    ring.interval_s = interval
+    engine = ENGINE
+
+    deadlines = (args.deadline_high_ms, args.deadline_normal_ms,
+                 args.deadline_low_ms)
+    rng = np.random.RandomState(47)
+
+    def drive(frac, seconds):
+        rate = max(4.0, sat * frac)
+        n = max(40, int(rate * seconds))
+        classes = rng.choice(len(PRIORITY_NAMES), n, p=OVERLOAD_MIX)
+        bodies = [json.dumps(
+            {"model": "bench",
+             "instances": calib_pool[rng.randint(len(calib_pool))]}
+        ).encode() for _ in range(n)]
+        workers = int(min(args.max_workers, max(8, rate * 0.4)))
+        recs, wall = _overload_step(
+            port, bodies, classes, rate, deadlines, workers,
+            timeout=max(deadlines) / 1e3 + 10.0)
+        ok = [r[3] * 1e3 for r in recs if r[1] == 200]
+        ok.sort()
+        return {"offered_x": frac, "offered_rps": round(rate, 1),
+                "achieved_rps": round(len(recs) / wall, 1),
+                "goodput_rps": round(len(ok) / wall, 1),
+                "ok": len(ok),
+                "sent": n,
+                "p50_ms": round(float(np.percentile(ok, 50)), 2)
+                if ok else None,
+                "p99_ms": round(float(np.percentile(ok, 99)), 2)
+                if ok else None}
+
+    guard = REGISTRY.counter("graftcheck", "recompiles.serving.bench")
+    recompiles0 = guard.value
+    TRACER.clear()
+    ring.start()
+
+    # phase 1 (light): measure the healthy latency the objective anchors
+    # on — the SLO threshold is 2x the light-load p99, capped at half the
+    # queue's drain bound so an overloaded queue CAN breach it even on a
+    # host whose light-load p99 is already high
+    light = drive(0.25, step_s)
+    light_p99_ms = light["p99_ms"] or 50.0
+    threshold_s = min(max(2.0 * light_p99_ms / 1e3, 0.02),
+                      0.5 * args.queue_seconds)
+    slo = SLO(name="bench.latency", kind="latency",
+              histogram="serving.http.latency_seconds",
+              threshold_s=threshold_s, objective=0.9,
+              fast_window_s=fast_w, slow_window_s=slow_w,
+              warn_burn=1.0, page_burn=2.0,
+              raise_after=2, clear_after=2,
+              labels={"model": "bench", "bench": "slo"})
+    engine.register(slo)
+    # availability rides along for the artifact (warn-only shape: the
+    # overload phase SHEDS by design — quota/shed/expiry are the bad
+    # events a fleet operator would watch, not gate here)
+    engine.register(SLO(
+        name="bench.availability", kind="availability", objective=0.5,
+        good_keys=tuple(f"serving.bench.batcher.accepted.{p}"
+                        for p in PRIORITY_NAMES),
+        bad_keys=tuple(f"serving.bench.batcher.{k}.{p}"
+                       for k in ("quota_rejected", "shed", "expired")
+                       for p in PRIORITY_NAMES),
+        fast_window_s=fast_w, slow_window_s=slow_w,
+        warn_burn=1.2, page_burn=1.8, raise_after=2, clear_after=2,
+        labels={"model": "bench", "bench": "slo"}))
+    engine.attach()
+
+    # phase 2 (confirm): the objective must hold at light load
+    confirm = drive(0.25, max(slow_w, step_s * 0.6))
+    st = engine.status()["slos"]["bench.latency"]
+    confirm_state = st["state"]
+    false_fire = st["peak_state"] == "page"
+
+    # phase 3 (overload): 2x saturation, long enough that BOTH windows
+    # burn and the hysteresis can fire; mid-phase, a side thread pulls
+    # /debug/bundle + /slo + /healthz off the live server
+    over_s = max(step_s, slow_w + 4 * fast_w)
+    mid = {}
+
+    def fetch_mid():
+        time.sleep(0.6 * over_s)
+        for key, url in (("bundle", f"/debug/bundle?n=20"),
+                         ("slo", "/slo"), ("healthz", "/healthz")):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{url}", timeout=10) as r:
+                    mid[key] = json.loads(r.read())
+            except Exception as e:
+                mid[key + "_error"] = repr(e)
+
+    fetcher = threading.Thread(target=fetch_mid, daemon=True)
+    fetcher.start()
+    over = drive(2.0, over_s)
+    fetcher.join(timeout=30.0)
+    fired = engine.status()["slos"]["bench.latency"]["peak_state"] == "page"
+
+    # phase 4 (recovery): light load until the overload observations age
+    # out of the slow window, then give the hysteresis a grace period of
+    # empty-window evaluations (an idle window is clearing evidence)
+    recovery = drive(0.25, slow_w + max(step_s, 4 * fast_w))
+    deadline_t = time.monotonic() + max(5.0, slow_w)
+    while time.monotonic() < deadline_t:
+        if engine.status()["slos"]["bench.latency"]["state"] == "ok":
+            break
+        time.sleep(interval)
+    final = engine.status()
+    cleared = final["slos"]["bench.latency"]["state"] == "ok"
+
+    ring.stop()
+    engine.detach()
+    steady_recompiles = int(guard.value - recompiles0)
+    overhead = ring.overhead()
+    server.shutdown()
+    registry.shutdown()
+
+    # mid-overload bundle completeness: every flight-recorder section,
+    # the deployed model, live SLO state and time-series history must be
+    # present in the document a curl got DURING the incident
+    from hivemall_tpu.runtime.debug_bundle import SECTIONS
+
+    bundle = mid.get("bundle") or {}
+    missing = [s for s in SECTIONS if s not in bundle]
+    bundle_ok = (not missing and not mid.get("bundle_error")
+                 and any(m.get("name") == "bench"
+                         for m in bundle.get("models", []))
+                 and "bench.latency" in bundle.get("slo", {}).get("slos", {})
+                 and len(bundle.get("timeseries", {}).get("samples", [])) > 0
+                 and len(bundle.get("traces", {}).get("last", [])) > 0)
+    healthz_mid = mid.get("healthz") or {}
+
+    result = {
+        "metric": f"serving_slo_burn_alert_arow_{args.dims}dims",
+        "value": float(fired and cleared),
+        "unit": "bool",
+        "methodology": "http_overload_ladder_multiwindow_burn_rate",
+        "device_set": _device_set(),
+        "recompiles": _recompile_counters(),
+        "calibration": {"burst_closed_loop_rps": round(burst_rps, 1),
+                        "saturation_rps": round(sat, 1),
+                        "mean_rows_per_request": round(mean_rows, 1),
+                        "max_queue_rows": int(max_queue_rows),
+                        "max_concurrent_requests": int(inflight_limit)},
+        "slo": {"threshold_ms": round(threshold_s * 1e3, 2),
+                "objective": 0.9,
+                "fast_window_s": round(fast_w, 3),
+                "slow_window_s": round(slow_w, 3),
+                "sample_interval_s": round(interval, 3)},
+        "phases": {"light": light, "confirm": confirm,
+                   "overload": over, "recovery": recovery},
+        "alert": {"fired_during_overload": fired,
+                  "cleared_after_recovery": cleared,
+                  "false_fire_at_light_load": false_fire,
+                  "confirm_state": confirm_state,
+                  "final_state": final["slos"]["bench.latency"]["state"],
+                  "transitions":
+                      final["slos"]["bench.latency"]["transitions"],
+                  "availability_peak":
+                      final["slos"]["bench.availability"]["peak_state"]},
+        "sampler": overhead,
+        "bundle_mid_overload": {"ok": bundle_ok,
+                                "missing_sections": missing,
+                                "error": mid.get("bundle_error"),
+                                "healthz_status":
+                                    healthz_mid.get("status"),
+                                "healthz_slo":
+                                    healthz_mid.get("slo")},
+        "steady_state_recompiles": steady_recompiles,
+    }
+    print(json.dumps(result))
+
+    rc = 0
+    if false_fire:
+        print("SLO FAIL: the latency objective PAGED at light load before "
+              "the overload step — the alert is not credible (threshold "
+              f"{threshold_s * 1e3:.1f} ms, light p99 {light_p99_ms} ms)",
+              file=sys.stderr)
+        rc = 1
+    if not fired:
+        print("SLO FAIL: the latency burn-rate alert never reached 'page' "
+              "during the 2x overload step — both windows must burn "
+              f"(threshold {threshold_s * 1e3:.1f} ms, overload p99 "
+              f"{over['p99_ms']} ms)", file=sys.stderr)
+        rc = 1
+    if not cleared:
+        print("SLO FAIL: the alert did not clear after recovery (state "
+              f"{final['slos']['bench.latency']['state']!r} after "
+              f"{slow_w:.1f}s slow window + grace)", file=sys.stderr)
+        rc = 1
+    if overhead["fraction"] >= 0.05:
+        print(f"SLO FAIL: sampler overhead {overhead['fraction']:.4f} >= "
+              f"0.05 of wall time ({overhead['samples']} samples, "
+              f"{overhead['sample_seconds']:.3f}s sampling over "
+              f"{overhead['elapsed_s']:.1f}s)", file=sys.stderr)
+        rc = 1
+    if not bundle_ok:
+        print(f"SLO FAIL: mid-overload /debug/bundle incomplete: "
+              f"missing={missing} error={mid.get('bundle_error')}",
+              file=sys.stderr)
+        rc = 1
+    if steady_recompiles:
+        print(f"SLO FAIL: steady_state_recompiles={steady_recompiles}",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 # -- skew mode: the hot-row cache under Zipfian traffic ----------------------
 
 def _zipf_probs(universe: int, s: float) -> np.ndarray:
@@ -1977,6 +2317,15 @@ def main() -> int:
                          "budgets; hard-fails when goodput at 2x drops "
                          "below --goodput-retention-min of peak, on shed-"
                          "counter inconsistency, or on recompiles")
+    ap.add_argument("--slo", action="store_true",
+                    help="SLO burn-rate alert gate: overload ladder "
+                         "(light -> 2x saturation -> recovery) with the "
+                         "time-series sampler + SLO engine live; "
+                         "hard-fails unless the latency burn alert fires "
+                         "during the 2x step AND clears after recovery, "
+                         "sampler overhead stays under 5%%, the "
+                         "mid-overload /debug/bundle is complete, and "
+                         "zero steady-state recompiles")
     ap.add_argument("--step-seconds", type=float, default=None,
                     help="seconds per offered-load step; default 8 "
                          "(2.5 under --smoke)")
@@ -2083,7 +2432,7 @@ def main() -> int:
               "holdout": (4000, 300),
               "step_seconds": (8.0, 2.5),
               "calib_requests": (600, 150)}
-    if args.overload:
+    if args.overload or args.slo:
         # the overload sweep sizes for SCORING-bound saturation: requests
         # carry hundreds of rows (prebuilt bytes on the client), so the
         # batcher's queue — where the admission machinery lives — is the
@@ -2186,6 +2535,15 @@ def main() -> int:
                 flags + " --xla_force_host_platform_device_count=8").strip()
             os.execv(sys.executable, [sys.executable] + sys.argv)
         return run_topk_mode(args)
+
+    if args.slo:
+        if args.artifact or args.http or args.quantize or args.sharded \
+                or args.skew or args.topk or args.overload:
+            raise SystemExit("--slo trains and deploys its own model and "
+                             "owns the process SLO engine; it does not "
+                             "compose with --artifact, --http, --quantize, "
+                             "--sharded, --skew, --topk or --overload")
+        return run_slo_mode(args)
 
     if args.overload:
         if args.artifact or args.http or args.quantize or args.sharded \

@@ -7,6 +7,21 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# per-gate wall-time ledger: every gate prints its cost so drift toward
+# the 1200 s tier-1 budget is attributable to a GATE per-PR, not just to
+# a test (--durations covers those); past 1000 s the ledger warns loudly
+# so the budget is defended before it is blown
+gate_t0=$SECONDS
+gate_time() {
+  local now=$SECONDS
+  echo "gate-time: $1 $((now - gate_t0))s (total ${now}s of 1200s budget)"
+  if (( now >= 1000 )); then
+    echo "gate-time: WARNING total ${now}s has crossed 1000s of the" \
+         "1200s tier-1 budget — trim a gate before the next PR" >&2
+  fi
+  gate_t0=$now
+}
+
 # native library freshness: rebuild libhivemall_native.so when the C++
 # source is newer, the .so cannot load on THIS host (the PR 11
 # GLIBCXX-mismatch silent-fallback pathology), or it predates the current
@@ -15,6 +30,7 @@ cd "$(dirname "$0")/.."
 # reason in-artifact). A present-but-broken toolchain fails here, before
 # any gate runs against a stale library.
 bash scripts/build_native.sh --if-stale
+gate_time "native-build"
 
 # tier-1 gate 1: graftcheck static analysis on changed files (+ their
 # callers) — any new non-baselined recompile/host-sync/dtype/axis/donation/
@@ -61,6 +77,7 @@ with open("analysis.sarif", "w", encoding="utf-8") as fh:
     json.dump(render_sarif(findings), fh, indent=2, sort_keys=True)
 print("graftcheck: merged full-tree SARIF archived at analysis.sarif")
 PY
+gate_time "graftcheck-lint"
 
 # tier-1 gate 2: no machine-applicable fix may be left unapplied in the
 # changed files — if `--fix` would produce a diff there, fail with the
@@ -68,12 +85,14 @@ PY
 # cleanliness is locked by the baseline test: a fixable finding is always
 # a non-baselined finding)
 bash scripts/lint.sh --fix-check
+gate_time "graftcheck-fix-check"
 
 # tier-1 gate 3: serving smoke — warmup then a bucket-sweeping load must
 # show ZERO steady-state recompiles, and an in-flight hot swap must fail
 # zero requests (docs/serving.md; prints one BENCH-style JSON line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --smoke
+gate_time "serving-smoke"
 
 # tier-1 gate 4: quantized-serving smoke — one tiny model frozen f32/bf16/
 # int8, served through all three engines: the int8/bf16 holdout logloss
@@ -82,6 +101,7 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # artifacts"; prints one BENCH-style JSON line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --quantize --smoke
+gate_time "quantize-smoke"
 
 # tier-1 gate 5: chaos smoke — a seeded device loss mid-run must end in an
 # elastic resume on a DIFFERENT simulated device count that converges to
@@ -89,6 +109,7 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # checkpointed work (docs/elastic_training.md; one BENCH-style JSON line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_chaos.py --smoke
+gate_time "chaos-smoke"
 
 # tier-1 gate 6: sharded-serving smoke — one model served single-device
 # and NamedSharding-striped over every admissible (batch, model) mesh
@@ -99,6 +120,7 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # prints one BENCH-style JSON line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --sharded --smoke
+gate_time "sharded-smoke"
 
 # tier-1 gate 7: overload smoke — a stepped offered-load sweep over
 # POST /predict (priority mix + deadline budgets through real sockets)
@@ -115,6 +137,7 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --overload --smoke || \
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --overload --smoke
+gate_time "overload-smoke"
 
 # tier-1 gate 8: batched-backend smoke — the segment-sum batch path
 # (-batch B, core/batch_update.py) must beat the row-serial JAX scan on
@@ -128,6 +151,7 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python bench.py --batch-smoke
+gate_time "batch-smoke"
 
 # tier-1 gate 9: continuous-training pipeline smoke — the stream ->
 # freeze -> eval gate -> hot-swap loop must land >= 3 gated publishes
@@ -138,6 +162,7 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # (docs/continuous_training.md; prints one BENCH-style JSON line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_pipeline.py --smoke
+gate_time "pipeline-smoke"
 
 # tier-1 gate 10: hot-row cache smoke — a pinned-Zipf closed-loop workload
 # against cache-on vs cache-off registry arms must show effective rows/sec
@@ -149,6 +174,7 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # & coalescing"; prints one BENCH-style JSON line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --skew --smoke
+gate_time "skew-smoke"
 
 # tier-1 gate 11: top-K retrieval smoke — the blocked streamed top-K
 # merge over an MF catalog must be BIT-identical (ids and f32 scores) to
@@ -160,6 +186,7 @@ env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # BENCH-style JSON line)
 env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python scripts/bench_serving.py --topk --smoke
+gate_time "topk-smoke"
 
 # tier-1 gate 12: native sanitizer pass — the parity/refusal suites run
 # against the ASan+UBSan-instrumented .so (halt_on_error: any heap
@@ -191,8 +218,27 @@ else
     python -m pytest tests/test_native.py tests/test_native_batch.py -q
   echo "native-sanitizer gate: PASSED (ASan+UBSan, halt_on_error)"
 fi
+gate_time "native-sanitizer"
+
+# tier-1 gate 13: SLO smoke — the overload ladder re-driven with the
+# time-series sampler + SLO engine live on the process singletons: the
+# latency burn-rate alert must FIRE (page) during the 2x step and CLEAR
+# after recovery, never fire at light load, the sampler must cost < 5%
+# of wall time, the mid-overload GET /debug/bundle must carry every
+# flight-recorder section, and the ladder must run with zero
+# steady-state recompiles (docs/observability.md "SLOs & burn rates";
+# prints one BENCH-style JSON line). One retry for the same reason as
+# gate 7: the ladder measures a live host — the alert SEMANTICS are
+# pinned deterministically in tests/test_slo.py, no retry there
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_serving.py --slo --smoke || \
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  python scripts/bench_serving.py --slo --smoke
+gate_time "slo-smoke"
 
 # --durations=15 keeps per-test cost visible so drift toward the 1200 s
-# tier-1 budget is attributable per-PR (ROADMAP hygiene)
-exec env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+# tier-1 budget is attributable per-PR (ROADMAP hygiene); no exec — the
+# ledger's final line below still needs this shell
+env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
   python -m pytest tests/ -q --durations=15 "$@"
+gate_time "pytest-tier1"

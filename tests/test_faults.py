@@ -167,6 +167,19 @@ def test_run_elastic_gives_up_after_max_restarts(tmp_path, w_true):
                         lambda t, i: _blk(i, w_true), 8, path,
                         checkpoint_every=4, max_restarts=2,
                         devices=list(jax.devices())[:2])
+    # the give-up path leaves a flight-recorder bundle next to the
+    # checkpoint (PR 20): complete sections, strictly-JSON, and a reason
+    # naming the budget and the fatal cause
+    from hivemall_tpu.runtime.debug_bundle import SECTIONS
+
+    crash_path = path + ".crash_bundle.json"
+    assert os.path.exists(crash_path), "give-up must write a crash bundle"
+    with open(crash_path, encoding="utf-8") as fh:
+        bundle = json.load(fh, parse_constant=lambda s: pytest.fail(
+            f"crash bundle is not strict JSON: emitted {s}"))
+    assert all(s in bundle for s in SECTIONS)
+    assert "gave up" in bundle["reason"]
+    assert "TransientStepError" in bundle["reason"]
 
 
 def test_crash_mid_write_preserves_previous_checkpoint(tmp_path, w_true):

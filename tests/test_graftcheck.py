@@ -548,13 +548,45 @@ def test_serving_and_runtime_are_concurrency_clean():
              # the fault injector both opt in — a lock hiding in either
              # would deadlock exactly when a restart is in flight
              os.path.join(PKG, "runtime", "recovery.py"),
-             os.path.join(PKG, "runtime", "faults.py")]
+             os.path.join(PKG, "runtime", "faults.py"),
+             # the observability stack (PR 20): the sampler thread, the
+             # SLO engine's listener evaluation and every /metrics scrape
+             # interleave with request handlers — expensive work under a
+             # ring/engine lock stalls sampling AND scraping at once
+             os.path.join(PKG, "runtime", "timeseries.py"),
+             os.path.join(PKG, "runtime", "slo.py"),
+             os.path.join(PKG, "runtime", "debug_bundle.py")]
     conc = [f for f in analyze_paths(paths)
             if f.rule in ("G012", "G013", "G014", "G015", "G016")]
     assert conc == [], "\n".join(f.format() for f in conc)
     baselined = [b for b in load_baseline()
                  if b.rule in ("G012", "G013", "G014", "G015", "G016")]
     assert baselined == [], "concurrency debt must be fixed, not baselined"
+
+
+def test_observability_modules_are_concurrency_hot():
+    """PR 20: the time-series sampler and the SLO engine joined the
+    G012-G016 hot scope by prefix (analysis/config.py) — their locks are
+    taken by the sampler thread, ring listeners and scrape handlers
+    concurrently with request traffic, so a blocking call under either
+    lock is a serving stall, not an observability detail."""
+    from hivemall_tpu.analysis import config
+
+    for mod in ("hivemall_tpu/runtime/timeseries.py",
+                "hivemall_tpu/runtime/slo.py"):
+        assert any(mod.startswith(p)
+                   for p in config.CONCURRENCY_HOT_PREFIXES), mod
+    # a synthetic blocking-under-lock hazard written as if inside the
+    # sampler fires WITHOUT any marker comment (prefix scope, not opt-in)
+    src = (
+        "import threading\n\n"
+        "lock = threading.Lock()\n\n\n"
+        "def bad(sock):\n"
+        "    with lock:\n"
+        "        sock.recv(1024)\n")
+    hits = [f.rule for f in analyze_source(
+        src, "hivemall_tpu/runtime/timeseries.py")]
+    assert "G013" in hits, hits
 
 
 def test_recompile_guard_counts_and_exports():

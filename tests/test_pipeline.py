@@ -542,3 +542,39 @@ def test_start_stop_thread_lifecycle(tmp_path):
     p.stop()
     rep = p.run(m["block_step"] + 4)
     assert rep["batches"] == m["block_step"] + 4 and rep["fatal"] is None
+
+
+def test_pipeline_giveup_writes_crash_bundle(tmp_path):
+    """Supervisor give-up (restart budget exhausted) writes the flight-
+    recorder bundle into artifact_root BEFORE re-raising (PR 20): the
+    postmortem artifact exists exactly when the process is about to die,
+    is strictly-JSON, carries every section, and its reason names the
+    budget and the fatal cause."""
+    import json
+
+    from hivemall_tpu.pipeline import ContinuousPipeline
+    from hivemall_tpu.runtime import faults
+    from hivemall_tpu.runtime.debug_bundle import SECTIONS
+
+    stream = _stream()
+    plan = faults.FaultPlan(seed=9, faults=tuple(
+        faults.Fault("transient_step", at_step=s) for s in (2, 3, 4)))
+    root = tmp_path / "giveup"
+    p = ContinuousPipeline(_registry(), stream.block,
+                           _cfg(root, max_restarts=1,
+                                restart_backoff_s=0.0))
+    with faults.inject(plan):
+        with pytest.raises(faults.TransientStepError):
+            p.run(20)
+    crash = os.path.join(str(root), "ctr_crash_bundle.json")
+    assert os.path.exists(crash), "give-up must leave a crash bundle"
+    with open(crash, encoding="utf-8") as fh:
+        bundle = json.load(fh, parse_constant=lambda s: pytest.fail(
+            f"crash bundle is not strict JSON: emitted {s}"))
+    assert all(s in bundle for s in SECTIONS)
+    assert "gave up" in bundle["reason"]
+    assert "TransientStepError" in bundle["reason"]
+    # the pipeline's registry is described (health may legitimately be
+    # an error dict mid-shutdown, but the section must exist and the
+    # registry was live here)
+    assert bundle["health"] is not None

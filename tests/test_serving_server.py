@@ -212,3 +212,64 @@ def test_multi_model_registry(stack):
     with pytest.raises(urllib.error.HTTPError) as e:
         _post(port, {"instances": ROWS[:1]})
     assert e.value.code == 404
+
+
+def test_per_priority_latency_histograms_and_slo_healthz(stack):
+    """PR 20 observability satellites on the serving port: every
+    successful /predict lands in BOTH the overall latency histogram and
+    its priority class's own (high/normal/low on /metrics), /healthz
+    carries the SLO block, and GET /slo + /debug/bundle are served with
+    the registry's models described."""
+    registry, port = stack
+    registry.deploy("ctr", train_arow(ROWS, LABELS, "-dims 256"),
+                    version="1")
+
+    def counts():
+        # the metrics registry is process-wide, so pin DELTAS, not totals
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        out = {}
+        for line in text.splitlines():
+            if line.startswith("hivemall_tpu_serving_http_latency_seconds") \
+                    and "_count " in line:
+                key, val = line.rsplit(" ", 1)
+                out[key] = float(val)
+        return text, out
+
+    metrics, before = counts()
+    for prio in ("high", "normal", "low"):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/predict",
+            data=json.dumps({"model": "ctr",
+                             "instances": ROWS[:2]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-priority": prio})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["model"] == "ctr"
+    metrics, after = counts()
+    for prio in ("high", "normal", "low"):
+        name = f"hivemall_tpu_serving_http_latency_seconds_{prio}"
+        assert f"# TYPE {name} histogram" in metrics
+        key = f"{name}_count"
+        assert after[key] - before.get(key, 0.0) == 1.0, \
+            f"{prio} class must record exactly its 1 request"
+    # the overall histogram saw all three
+    overall = "hivemall_tpu_serving_http_latency_seconds_count"
+    assert after[overall] - before.get(overall, 0.0) == 3.0
+
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+    assert "slo" in health
+    assert set(health["slo"]) == {"worst_state", "paging", "warning",
+                                  "evaluated"}
+    # no objective is paging here, so SLO burn must not degrade health
+    assert health["slo"]["paging"] == []
+
+    slo_doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/slo", timeout=10).read())
+    assert "slos" in slo_doc and "worst_state" in slo_doc
+    bundle = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/bundle?n=5", timeout=10).read())
+    # the serving server carries its registry: models are described
+    assert any(m.get("name") == "ctr" for m in bundle["models"])
+    assert bundle["health"] is not None
